@@ -1,0 +1,34 @@
+"""mpisppy_tpu: a TPU-native framework for optimization under uncertainty.
+
+A ground-up redesign of the capabilities of mpi-sppy (reference:
+/root/reference, pure-Python + MPI + Pyomo + commercial MIP solvers) for the
+JAX/XLA/TPU stack:
+
+- scenarios are a stacked, HBM-resident batch of standard-form LP/QP tensors
+  (instead of per-rank Pyomo ConcreteModels, ref. mpisppy/spbase.py:242),
+- per-scenario subproblem solves are a vmapped batched ADMM QP solver
+  (instead of Gurobi/CPLEX via SolverFactory, ref. mpisppy/phbase.py:1304),
+- nonanticipativity reductions (x-bar, W) are mesh collectives / batched
+  matmuls (instead of per-tree-node MPI Allreduce, ref. mpisppy/phbase.py:196),
+- the hub-and-spoke "cylinders" architecture is recreated as host-coordinated
+  asynchronous exchanges with the same write-id freshness protocol
+  (ref. mpisppy/cylinders/spcommunicator.py:97-124).
+"""
+
+import time as _time
+
+__version__ = "0.1.0"
+
+_T0 = _time.monotonic()
+
+
+def global_toc(msg: str, cond: bool = True) -> None:
+    """Wall-clock trace line, mirroring the reference's global_toc
+    (ref. mpisppy/__init__.py:22-28): stamps ``[ssss.ss] msg``."""
+    if cond:
+        print(f"[{_time.monotonic() - _T0:8.2f}] {msg}", flush=True)
+
+
+def tictoc() -> float:
+    """Seconds since process start of this framework."""
+    return _time.monotonic() - _T0

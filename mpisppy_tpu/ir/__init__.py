@@ -1,0 +1,4 @@
+from .model import Model, AffExpr, Constraint  # noqa: F401
+from .standard_form import StandardForm  # noqa: F401
+from .tree import ScenarioTree, two_stage_tree, balanced_tree  # noqa: F401
+from .batch import ScenarioBatch, build_batch  # noqa: F401

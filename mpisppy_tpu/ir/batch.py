@@ -1,0 +1,110 @@
+"""ScenarioBatch: the stacked, device-ready scenario tensor block.
+
+This is the TPU replacement for the reference's per-rank dict of Pyomo
+models (ref. mpisppy/spbase.py:242 _create_scenarios): all S scenarios of a
+problem are lowered to StandardForm and stacked along a leading scenario
+axis. The scenario axis is the data-parallel mesh axis (ref. SURVEY §2.3
+axis 1); everything the algorithms need per-iteration lives in these arrays.
+
+Nonant bookkeeping mirrors _attach_nonant_indices (ref. spbase.py:272):
+``nonant_idx`` maps the K nonanticipative slots (concatenated over non-leaf
+stages) into columns of x, and ``nonant_stage`` records each slot's stage.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .standard_form import StandardForm, lower
+from .tree import ScenarioTree
+
+
+@dataclass
+class ScenarioBatch:
+    tree: ScenarioTree
+    template: StandardForm          # scenario 0's form (shared structure)
+    # stacked numeric data (numpy on host; engines move to device)
+    c: np.ndarray                   # (S, n)
+    c0: np.ndarray                  # (S,)
+    P_diag: np.ndarray              # (S, n)
+    A: np.ndarray                   # (S, m, n)
+    l: np.ndarray                   # (S, m)
+    u: np.ndarray                   # (S, m)
+    lb: np.ndarray                  # (S, n)
+    ub: np.ndarray                  # (S, n)
+    c_stage: np.ndarray             # (S, T, n)
+    c0_stage: np.ndarray            # (S, T)
+    prob: np.ndarray                # (S,)
+    # nonant structure (shared across scenarios)
+    nonant_idx: np.ndarray          # (K,) int columns of x
+    nonant_stage: np.ndarray        # (K,) int 1-based stage per slot
+    stage_slot_slices: list = field(default_factory=list)  # per non-leaf stage: slice into K
+
+    @property
+    def S(self):
+        return self.c.shape[0]
+
+    @property
+    def n(self):
+        return self.c.shape[1]
+
+    @property
+    def m(self):
+        return self.A.shape[1]
+
+    @property
+    def K(self):
+        return self.nonant_idx.shape[0]
+
+    @property
+    def integer(self):
+        return self.template.integer
+
+    def nonants_of(self, x):
+        """Extract the (.., K) nonant slots from a (.., n) x array."""
+        return x[..., self.nonant_idx]
+
+
+def build_batch(scenario_creator, tree: ScenarioTree, creator_kwargs=None,
+                num_stages=None) -> ScenarioBatch:
+    """Call `scenario_creator(name, **kwargs) -> Model` for every scenario in
+    the tree and stack the lowered forms. The creator contract mirrors the
+    reference's (ref. spbase.py:477-492) minus the Pyomo attachments: the
+    tree (not the model) declares the nonant variable names per stage.
+    """
+    creator_kwargs = creator_kwargs or {}
+    T = num_stages or tree.num_stages
+    forms = [lower(scenario_creator(name, **creator_kwargs), num_stages=T)
+             for name in tree.scen_names]
+    f0 = forms[0]
+    for f in forms[1:]:
+        if f.n != f0.n or f.m != f0.m or f.var_names != f0.var_names:
+            raise ValueError(
+                f"scenario {f.name} has different structure from {f0.name}: "
+                "all scenarios must share variables and constraint counts")
+
+    # nonant slots, concatenated by stage
+    nonant_idx, nonant_stage, slot_slices = [], [], []
+    k = 0
+    for t, names in enumerate(tree.nonant_names_per_stage, start=1):
+        for vn in names:
+            sl = f0.var_slices[vn]
+            nonant_idx.extend(range(sl.start, sl.stop))
+            nonant_stage.extend([t] * (sl.stop - sl.start))
+        slot_slices.append(slice(k, len(nonant_idx)))
+        k = len(nonant_idx)
+
+    stack = lambda attr: np.stack([getattr(f, attr) for f in forms])
+    return ScenarioBatch(
+        tree=tree, template=f0,
+        c=stack("c"), c0=stack("c0"), P_diag=stack("P_diag"),
+        A=stack("A"), l=stack("l"), u=stack("u"),
+        lb=stack("lb"), ub=stack("ub"),
+        c_stage=stack("c_stage"), c0_stage=stack("c0_stage"),
+        prob=tree.probabilities.copy(),
+        nonant_idx=np.asarray(nonant_idx, dtype=np.int32),
+        nonant_stage=np.asarray(nonant_stage, dtype=np.int32),
+        stage_slot_slices=slot_slices,
+    )

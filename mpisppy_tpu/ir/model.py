@@ -1,0 +1,288 @@
+"""Declarative scenario-model DSL.
+
+This replaces the reference's L0 substrate (user-written Pyomo ConcreteModels,
+ref. examples/farmer/farmer.py:23-83) with a small affine modeling layer that
+lowers directly to standard-form tensors (see standard_form.py). The user
+contract mirrors the reference's ``scenario_creator`` protocol
+(ref. mpisppy/spbase.py:477-492): a callback builds one Model per scenario and
+declares which variables are nonanticipative at which stage.
+
+Design notes (TPU-first):
+- Expressions are *vectorized*: an ``AffExpr`` is a stack of affine rows
+  ``M_v @ x_v + const`` held as dense numpy blocks per variable. Model build
+  happens once on the host; the hot path consumes only the lowered tensors.
+- Every scenario of a problem must produce the same structure (same variables,
+  same constraint counts) so scenarios stack into one batch; only the numeric
+  data may differ. This is what lets the scenario axis be a mesh axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_INF = np.inf
+
+
+def _as2d(M, size):
+    M = np.asarray(M, dtype=np.float64)
+    if M.ndim == 0:
+        return M.reshape(1, 1) * np.eye(size)[:1] if size == 1 else None
+    return M
+
+
+class Var:
+    """A (flat) decision-variable block of a Model."""
+
+    __slots__ = ("model", "name", "size", "lb", "ub", "integer", "stage", "offset")
+
+    def __init__(self, model, name, size, lb, ub, integer, stage, offset):
+        self.model = model
+        self.name = name
+        self.size = int(size)
+        self.lb = np.broadcast_to(np.asarray(lb, dtype=np.float64), (self.size,)).copy()
+        self.ub = np.broadcast_to(np.asarray(ub, dtype=np.float64), (self.size,)).copy()
+        self.integer = bool(integer)
+        self.stage = int(stage)
+        self.offset = int(offset)  # start index in the flat x vector
+
+    # ---- expression protocol: a Var acts as the identity AffExpr ----
+    def _aff(self):
+        return AffExpr({self.name: np.eye(self.size)}, np.zeros(self.size), self.model)
+
+    def __getitem__(self, idx):
+        rows = np.eye(self.size)[idx]
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        return AffExpr({self.name: rows}, np.zeros(rows.shape[0]), self.model)
+
+    def sum(self):
+        return AffExpr({self.name: np.ones((1, self.size))}, np.zeros(1), self.model)
+
+    def dot(self, c):
+        c = np.asarray(c, dtype=np.float64).reshape(1, self.size)
+        return AffExpr({self.name: c}, np.zeros(1), self.model)
+
+    def __add__(self, o):
+        return self._aff() + o
+
+    def __radd__(self, o):
+        return self._aff() + o
+
+    def __sub__(self, o):
+        return self._aff() - o
+
+    def __rsub__(self, o):
+        return (-1.0) * self._aff() + o
+
+    def __mul__(self, c):
+        return self._aff() * c
+
+    def __rmul__(self, c):
+        return self._aff() * c
+
+    def __neg__(self):
+        return (-1.0) * self._aff()
+
+    def __rmatmul__(self, M):
+        M = np.atleast_2d(np.asarray(M, dtype=np.float64))
+        return AffExpr({self.name: M}, np.zeros(M.shape[0]), self.model)
+
+    def __le__(self, o):
+        return self._aff() <= o
+
+    def __ge__(self, o):
+        return self._aff() >= o
+
+    def __eq__(self, o):  # noqa: PLW3201 - intentional constraint builder
+        return self._aff() == o
+
+    def __hash__(self):
+        return id(self)
+
+
+class AffExpr:
+    """A stack of m affine rows over the model's variables.
+
+    Stored as ``coeffs[varname] -> (m, size_v) ndarray`` plus ``const (m,)``.
+    """
+
+    __slots__ = ("coeffs", "const", "model")
+
+    def __init__(self, coeffs, const, model):
+        self.coeffs = coeffs
+        self.const = np.asarray(const, dtype=np.float64)
+        self.model = model
+
+    @property
+    def m(self):
+        return self.const.shape[0]
+
+    @staticmethod
+    def _coerce(o, model, m):
+        """Coerce `o` to an AffExpr with m rows (broadcasting constants)."""
+        if isinstance(o, Var):
+            o = o._aff()
+        if isinstance(o, AffExpr):
+            return o
+        arr = np.asarray(o, dtype=np.float64).reshape(-1)
+        if arr.shape[0] == 1 and m > 1:
+            arr = np.broadcast_to(arr, (m,))
+        return AffExpr({}, arr.copy(), model)
+
+    def _zip(self, o):
+        o = AffExpr._coerce(o, self.model, self.m)
+        m = max(self.m, o.m)
+        return o, m
+
+    def _bcast(self, m):
+        if self.m == m:
+            return self
+        if self.m != 1:
+            raise ValueError(f"row mismatch: {self.m} vs {m}")
+        coeffs = {k: np.repeat(v, m, axis=0) for k, v in self.coeffs.items()}
+        return AffExpr(coeffs, np.repeat(self.const, m), self.model)
+
+    def __add__(self, o):
+        o, m = self._zip(o)
+        a, b = self._bcast(m), o._bcast(m)
+        coeffs = dict(a.coeffs)
+        for k, v in b.coeffs.items():
+            coeffs[k] = coeffs[k] + v if k in coeffs else v
+        return AffExpr(coeffs, a.const + b.const, self.model)
+
+    def __radd__(self, o):
+        return self + o
+
+    def __sub__(self, o):
+        o, m = self._zip(o)
+        return self + (-1.0) * o
+
+    def __rsub__(self, o):
+        return (-1.0) * self + o
+
+    def __mul__(self, c):
+        c = np.asarray(c, dtype=np.float64)
+        if c.ndim == 0:
+            coeffs = {k: v * float(c) for k, v in self.coeffs.items()}
+            return AffExpr(coeffs, self.const * float(c), self.model)
+        c = c.reshape(-1)
+        a = self._bcast(c.shape[0]) if self.m == 1 else self
+        if a.m != c.shape[0]:
+            raise ValueError("elementwise scale size mismatch")
+        coeffs = {k: v * c[:, None] for k, v in a.coeffs.items()}
+        return AffExpr(coeffs, a.const * c, self.model)
+
+    def __rmul__(self, c):
+        return self * c
+
+    def __neg__(self):
+        return self * -1.0
+
+    def sum(self):
+        coeffs = {k: v.sum(axis=0, keepdims=True) for k, v in self.coeffs.items()}
+        return AffExpr(coeffs, np.array([self.const.sum()]), self.model)
+
+    # ---- constraint builders ----
+    def __le__(self, o):
+        diff = self - o
+        return Constraint(diff, lo=np.full(diff.m, -_INF), hi=-diff.const + 0.0)
+
+    def __ge__(self, o):
+        diff = self - o
+        return Constraint(diff, lo=-diff.const + 0.0, hi=np.full(diff.m, _INF))
+
+    def __eq__(self, o):  # noqa: PLW3201
+        diff = self - o
+        rhs = -diff.const + 0.0
+        return Constraint(diff, lo=rhs, hi=rhs.copy())
+
+    def __hash__(self):
+        return id(self)
+
+
+class Constraint:
+    """``lo <= rows(expr) <= hi`` where the expr's constant has been folded
+    into lo/hi (OSQP two-sided form; eq constraints have lo == hi)."""
+
+    __slots__ = ("expr", "lo", "hi", "name")
+
+    def __init__(self, expr, lo, hi, name=None):
+        # strip the constant out of expr; bounds already account for it
+        self.expr = AffExpr(expr.coeffs, np.zeros(expr.m), expr.model)
+        self.lo = np.asarray(lo, dtype=np.float64)
+        self.hi = np.asarray(hi, dtype=np.float64)
+        self.name = name
+
+    def ranged(self, lo, hi):
+        """Explicit two-sided bounds (like Pyomo's (lb, expr, ub) tuples,
+        ref. examples/farmer/farmer.py EnforceQuotas_rule)."""
+        m = self.expr.m
+        self.lo = np.broadcast_to(np.asarray(lo, dtype=np.float64), (m,)).copy()
+        self.hi = np.broadcast_to(np.asarray(hi, dtype=np.float64), (m,)).copy()
+        return self
+
+
+class Model:
+    """One scenario's optimization model (minimization canonical form).
+
+    Replaces the Pyomo ConcreteModel + ``_mpisppy_node_list`` contract
+    (ref. mpisppy/spbase.py:477-492). Stage costs are declared per stage;
+    nonant declarations happen through the tree (ir/tree.py) by naming
+    variables, mirroring ScenarioNode's nonant_list
+    (ref. mpisppy/scenario_tree.py:41-103).
+    """
+
+    def __init__(self, name="model", sense="min"):
+        assert sense in ("min", "max")
+        self.name = name
+        self.sense = sense
+        self.vars: dict[str, Var] = {}
+        self.constraints: list[Constraint] = []
+        self._stage_costs: dict[int, AffExpr] = {}
+        self._quad_diag: dict[str, np.ndarray] = {}  # optional ½ d_i x_i² terms
+        self._n = 0
+
+    # ---- declaration API ----
+    def var(self, name, size=1, lb=0.0, ub=_INF, integer=False, stage=2):
+        if name in self.vars:
+            raise ValueError(f"duplicate var {name}")
+        v = Var(self, name, size, lb, ub, integer, stage, self._n)
+        self.vars[name] = v
+        self._n += v.size
+        return v
+
+    def constr(self, con: Constraint, name=None):
+        if not isinstance(con, Constraint):
+            raise TypeError("expected a Constraint (use <=, >=, ==)")
+        con.name = name
+        self.constraints.append(con)
+        return con
+
+    def stage_cost(self, stage: int, expr):
+        """Declare the cost expression for a stage (scalar AffExpr).
+        Mirrors ScenarioNode.cost_expression (ref. scenario_tree.py:41)."""
+        if isinstance(expr, Var):
+            expr = expr._aff()
+        if isinstance(expr, AffExpr):
+            expr = expr.sum() if expr.m > 1 else expr
+        else:
+            expr = AffExpr({}, np.array([float(expr)]), self)
+        self._stage_costs[int(stage)] = expr
+
+    def quad_cost(self, var: Var, diag):
+        """Add ½ Σ d_i x_i² to the objective (diagonal quadratic)."""
+        d = np.broadcast_to(np.asarray(diag, dtype=np.float64), (var.size,))
+        self._quad_diag[var.name] = self._quad_diag.get(var.name, 0.0) + d
+
+    # ---- introspection ----
+    @property
+    def n(self):
+        return self._n
+
+    @property
+    def num_stages(self):
+        return max(self._stage_costs) if self._stage_costs else 1
+
+    def var_slice(self, name):
+        v = self.vars[name]
+        return slice(v.offset, v.offset + v.size)

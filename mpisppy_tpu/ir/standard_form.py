@@ -1,0 +1,114 @@
+"""Lowering: Model -> standard-form tensors.
+
+The canonical subproblem form is the two-sided (OSQP) form
+
+    min  ½ xᵀ diag(P) x + cᵀx + c0
+    s.t. l ≤ A x ≤ u,     lb ≤ x ≤ ub,     x_i ∈ ℤ for integer i
+
+which uniformly captures equalities (l == u), one-sided inequalities, and
+ranged constraints. This replaces the reference's L0/L1 path where Pyomo
+expression trees are handed verbatim to a commercial solver
+(ref. mpisppy/phbase.py:1307); here every scenario becomes a fixed-shape
+tensor block so that scenarios stack into an HBM-resident batch.
+
+Stage structure is preserved: ``c_stage[t]`` is the stage-(t+1) linear cost
+row (they sum to ``c``), mirroring ScenarioNode.cost_expression
+(ref. mpisppy/scenario_tree.py:41-103) and enabling Ebound/Eobjective-style
+per-stage reporting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class StandardForm:
+    name: str
+    n: int
+    m: int
+    c: np.ndarray          # (n,)
+    c0: float
+    P_diag: np.ndarray     # (n,) diagonal quadratic cost (0 for LPs)
+    A: np.ndarray          # (m, n) dense constraint matrix
+    l: np.ndarray          # (m,)
+    u: np.ndarray          # (m,)
+    lb: np.ndarray         # (n,)
+    ub: np.ndarray         # (n,)
+    integer: np.ndarray    # (n,) bool
+    stage_of_var: np.ndarray  # (n,) int, 1-based stage of each variable
+    c_stage: np.ndarray    # (num_stages, n) per-stage linear cost
+    c0_stage: np.ndarray   # (num_stages,)
+    var_names: list = field(default_factory=list)
+    var_slices: dict = field(default_factory=dict)
+    sense: str = "min"     # lowered form is always minimization; this records
+                           # the user sense so objective values can be reported
+                           # in the user's convention
+
+    def var_values(self, x, name):
+        sl = self.var_slices[name]
+        return x[..., sl]
+
+    def objective(self, x):
+        return 0.5 * np.dot(x * self.P_diag, x) + np.dot(self.c, x) + self.c0
+
+
+def lower(model, num_stages=None) -> StandardForm:
+    """Lower a Model to StandardForm (always minimization)."""
+    n = model.n
+    sign = 1.0 if model.sense == "min" else -1.0
+    T = int(num_stages or model.num_stages)
+
+    c_stage = np.zeros((T, n))
+    c0_stage = np.zeros(T)
+    for t, expr in model._stage_costs.items():
+        row = np.zeros(n)
+        for vname, M in expr.coeffs.items():
+            row[model.var_slice(vname)] += M.reshape(-1)
+        c_stage[t - 1] += sign * row
+        c0_stage[t - 1] += sign * float(expr.const.sum())
+
+    P = np.zeros(n)
+    for vname, d in model._quad_diag.items():
+        P[model.var_slice(vname)] += sign * d
+
+    rows, los, his = [], [], []
+    for con in model.constraints:
+        M = np.zeros((con.expr.m, n))
+        for vname, B in con.expr.coeffs.items():
+            M[:, model.var_slice(vname)] += B
+        rows.append(M)
+        los.append(con.lo)
+        his.append(con.hi)
+    if rows:
+        A = np.concatenate(rows, axis=0)
+        l = np.concatenate(los)
+        u = np.concatenate(his)
+    else:
+        A = np.zeros((0, n))
+        l = np.zeros(0)
+        u = np.zeros(0)
+
+    lb = np.zeros(n)
+    ub = np.zeros(n)
+    integer = np.zeros(n, dtype=bool)
+    stage_of_var = np.zeros(n, dtype=np.int32)
+    names, slices = [], {}
+    for vname, v in model.vars.items():
+        sl = model.var_slice(vname)
+        lb[sl], ub[sl] = v.lb, v.ub
+        integer[sl] = v.integer
+        stage_of_var[sl] = v.stage
+        names.append(vname)
+        slices[vname] = sl
+
+    return StandardForm(
+        name=model.name, n=n, m=A.shape[0],
+        c=c_stage.sum(axis=0), c0=float(c0_stage.sum()),
+        P_diag=P, A=A, l=l, u=u, lb=lb, ub=ub,
+        integer=integer, stage_of_var=stage_of_var,
+        c_stage=c_stage, c0_stage=c0_stage,
+        var_names=names, var_slices=slices, sense=model.sense,
+    )

@@ -1,0 +1,117 @@
+"""Scenario tree metadata.
+
+Replaces the reference's ScenarioNode / _ScenTree machinery
+(ref. mpisppy/scenario_tree.py:41-103, mpisppy/utils/sputils.py:543-661).
+The reference attaches per-scenario node lists to Pyomo models and later
+derives rank maps and per-node MPI communicators (ref. mpisppy/spbase.py:311).
+Here the tree is a pure index structure consumed by the batched engines:
+
+- every non-leaf node has an id; scenarios record their node path by stage,
+- per-stage *membership matrices* B_t ∈ {0,1}^{S×N_t} ("scenario s passes
+  through node j of stage t") turn nonanticipativity reductions into dense
+  matmuls: xbar_t = B_t (B_tᵀ(p⊙x_t)) / (B_tᵀp).  On a sharded scenario axis
+  the inner product B_tᵀ(p⊙x_t) is a local matmul followed by a psum — the
+  TPU-native analog of the reference's per-node comm.Allreduce
+  (ref. mpisppy/phbase.py:196-201, spbase.py:311-350).
+- nonant variable names are declared per stage, mirroring nonant_list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class TreeNode:
+    name: str
+    stage: int              # 1-based
+    cond_prob: float
+    parent: "TreeNode | None"
+    idx_in_stage: int = -1  # assigned by ScenarioTree
+
+
+class ScenarioTree:
+    """Non-leaf tree structure for S scenarios over T decision stages.
+
+    ``node_path[s][t]`` = index (within stage t+1's node list) of the node
+    scenario s passes through. Stage 1 always has the single ROOT node.
+    """
+
+    def __init__(self, scen_names, node_paths, nodes_per_stage, nonant_names_per_stage,
+                 probabilities=None):
+        self.scen_names = list(scen_names)
+        self.S = len(self.scen_names)
+        self.num_stages = len(nodes_per_stage) + 1  # leaves are implicit
+        self.nodes_per_stage = list(nodes_per_stage)  # N_t for t = 1..T-1
+        self.node_path = np.asarray(node_paths, dtype=np.int32)  # (S, T-1)
+        assert self.node_path.shape == (self.S, self.num_stages - 1)
+        # nonant variable names owned by each non-leaf stage
+        self.nonant_names_per_stage = [list(v) for v in nonant_names_per_stage]
+        if probabilities is None:
+            probabilities = np.full(self.S, 1.0 / self.S)
+        self.probabilities = np.asarray(probabilities, dtype=np.float64)
+
+    def membership(self, stage: int) -> np.ndarray:
+        """B_t ∈ {0,1}^{S×N_t} for 1-based non-leaf stage `stage`."""
+        N = self.nodes_per_stage[stage - 1]
+        B = np.zeros((self.S, N))
+        B[np.arange(self.S), self.node_path[:, stage - 1]] = 1.0
+        return B
+
+    def validate(self):
+        assert abs(self.probabilities.sum() - 1.0) < 1e-9, "probabilities must sum to 1"
+        for t in range(1, self.num_stages):
+            B = self.membership(t)
+            assert (B.sum(axis=1) == 1).all()
+        # node-contiguity (analogous to the reference's rank-map guarantee,
+        # ref. sputils.py:635-659): scenarios of one node occupy a contiguous
+        # index range so a sharded scenario axis keeps nodes on contiguous
+        # mesh slices.
+        for t in range(1, self.num_stages):
+            path = self.node_path[:, t - 1]
+            changes = np.flatnonzero(np.diff(path) != 0)
+            seen = path[np.concatenate([[0], changes + 1])]
+            assert len(set(seen.tolist())) == len(seen), \
+                f"stage {t} scenario order is not node-contiguous"
+
+
+def two_stage_tree(scen_names, nonant_names, probabilities=None) -> ScenarioTree:
+    """All scenarios share the single ROOT node (the common case,
+    ref. sputils.py:665 attach_root_node)."""
+    S = len(scen_names)
+    return ScenarioTree(
+        scen_names=scen_names,
+        node_paths=np.zeros((S, 1), dtype=np.int32),
+        nodes_per_stage=[1],
+        nonant_names_per_stage=[list(nonant_names)],
+        probabilities=probabilities,
+    )
+
+
+def balanced_tree(branching_factors, nonant_names_per_stage, scen_name_fmt="Scen{}",
+                  probabilities=None) -> ScenarioTree:
+    """Balanced multistage tree from branching factors (the reference's
+    --BFs convention, ref. utils/baseparsers.py:134-168; hydro uses [3,3]).
+
+    For BFs = [b1, ..., b_{T-1}] there are prod(BFs) scenarios; the stage-t
+    node of scenario s is s // prod(BFs[t-1:]).
+    """
+    BFs = list(branching_factors)
+    S = int(np.prod(BFs))
+    T1 = len(BFs)  # number of non-root branching stages; total stages = T1+1
+    nodes_per_stage = [1]
+    for b in BFs[:-1]:
+        nodes_per_stage.append(nodes_per_stage[-1] * b)
+    node_paths = np.zeros((S, T1), dtype=np.int32)
+    for t in range(T1):
+        block = int(np.prod(BFs[t:]))
+        node_paths[:, t] = np.arange(S) // block
+    return ScenarioTree(
+        scen_names=[scen_name_fmt.format(i + 1) for i in range(S)],
+        node_paths=node_paths,
+        nodes_per_stage=nodes_per_stage,
+        nonant_names_per_stage=nonant_names_per_stage,
+        probabilities=probabilities,
+    )

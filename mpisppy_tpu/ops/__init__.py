@@ -1,0 +1,1 @@
+from .qp_solver import QPData, QPFactors, QPState, qp_setup, qp_solve, fold_bounds  # noqa: F401

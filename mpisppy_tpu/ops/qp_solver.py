@@ -1,0 +1,221 @@
+"""Batched dense ADMM QP/LP solver (OSQP-style), the framework's native
+subproblem kernel.
+
+The reference rents a commercial MIP solver per scenario through Pyomo
+(ref. mpisppy/phbase.py:1304-1362, solve_loop :999) — one process-boundary
+solver call per subproblem per PH iteration, which is where ~all of its
+wall-clock goes. Here the whole scenario batch is solved simultaneously on
+the TPU: every operation below is a batched matmul / triangular solve /
+elementwise op over the leading scenario axis, so S scenarios cost one MXU
+pass, not S solver calls.
+
+Form:   min ½ xᵀ diag(P) x + qᵀx   s.t.  l ≤ A x ≤ u
+(variable bounds are folded into A as identity rows by ``fold_bounds``).
+
+Method: ADMM as in OSQP (Stellato et al. 2020) with
+ - Ruiz equilibration of the KKT matrix for conditioning,
+ - per-row stepsize rho (boosted on equality rows),
+ - a cached dense Cholesky factor of M = diag(P) + σI + Aᵀdiag(ρ)A — the key
+   PH synergy: PH iterations change only q (W and the prox center x̄), so the
+   factorization amortizes across the entire PH run,
+ - warm starting from the previous (x, y, z),
+ - periodic residual checks inside a lax.while_loop (compiler-friendly
+   control flow; no Python in the loop).
+
+Why ADMM and not simplex/IPM: the iteration is pure BLAS-3 over the batch
+(MXU-friendly, no pivoting/branching), tolerances ~1e-6..1e-8 in f64 and
+~1e-4 in f32 are ample for PH/bounding, and the factor-caching matches PH's
+access pattern exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QPData(NamedTuple):
+    """Stacked problem data; leading axis S = scenarios."""
+    P_diag: jax.Array   # (S, n)
+    A: jax.Array        # (S, m, n) with bound rows folded in
+    l: jax.Array        # (S, m)
+    u: jax.Array        # (S, m)
+
+
+class QPFactors(NamedTuple):
+    """Setup artifacts reused across solves with different q."""
+    L: jax.Array        # (S, n, n) Cholesky factor of M
+    rho: jax.Array      # (S, m) per-row stepsize
+    sigma: jax.Array    # scalar
+    D: jax.Array        # (S, n) column equilibration
+    E: jax.Array        # (S, m) row equilibration
+    cost_scale: jax.Array  # (S,) objective scaling
+    A_s: jax.Array      # (S, m, n) scaled A
+    P_s: jax.Array      # (S, n) scaled P diagonal
+
+
+class QPState(NamedTuple):
+    x: jax.Array        # (S, n) scaled iterate
+    y: jax.Array        # (S, m) scaled dual
+    z: jax.Array        # (S, m) scaled slack
+    iters: jax.Array    # (S,) or scalar total iterations run
+    pri_res: jax.Array  # (S,)
+    dua_res: jax.Array  # (S,)
+
+
+def fold_bounds(P_diag, A, l, u, lb, ub):
+    """Append identity rows for variable bounds -> pure two-sided row form."""
+    S, m, n = A.shape
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=A.dtype), (S, n, n))
+    return QPData(
+        P_diag=jnp.asarray(P_diag),
+        A=jnp.concatenate([A, eye], axis=1),
+        l=jnp.concatenate([l, lb], axis=1),
+        u=jnp.concatenate([u, ub], axis=1),
+    )
+
+
+def _ruiz_equilibrate(P_diag, A, iters=15):
+    """Modified Ruiz equilibration of the KKT matrix [[P, Aᵀ],[A, 0]].
+
+    Returns (D, E) with scaled P̄ = D P D (diag), Ā = E A D, all batched.
+    Infinite bounds are untouched (they scale to ±inf harmlessly).
+    """
+    S, m, n = A.shape
+    D = jnp.ones((S, n), A.dtype)
+    E = jnp.ones((S, m), A.dtype)
+
+    def body(_, DE):
+        D, E = DE
+        As = E[:, :, None] * A * D[:, None, :]
+        Ps = D * P_diag * D
+        # column norms of the KKT block column for x: max(|Ps|, colmax|As|)
+        cnorm = jnp.maximum(jnp.abs(Ps), jnp.max(jnp.abs(As), axis=1))
+        rnorm = jnp.max(jnp.abs(As), axis=2)
+        d = 1.0 / jnp.sqrt(jnp.maximum(cnorm, 1e-8))
+        e = 1.0 / jnp.sqrt(jnp.maximum(rnorm, 1e-8))
+        # guard empty rows/cols
+        d = jnp.where(cnorm < 1e-12, 1.0, d)
+        e = jnp.where(rnorm < 1e-12, 1.0, e)
+        return D * d, E * e
+
+    D, E = jax.lax.fori_loop(0, iters, body, (D, E))
+    return D, E
+
+
+@partial(jax.jit, static_argnames=("eq_boost",))
+def qp_setup(data: QPData, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
+    """Equilibrate, choose per-row rho, factor M. O(S·n³) once per problem
+    (and once per PH rho change); solves reuse the factor."""
+    P_diag, A, l, u = data
+    dt = A.dtype
+    D, E = _ruiz_equilibrate(P_diag, A)
+    A_s = E[:, :, None] * A * D[:, None, :]
+    P_s = D * P_diag * D
+    l_s = E * l
+    u_s = E * u
+    # cost scaling: normalize scaled gradient magnitude ~ 1 (OSQP uses
+    # 1/max(mean col norms); a cheap robust proxy here)
+    cost_scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(P_s), axis=1), 1.0)
+    P_s = P_s * cost_scale[:, None]
+
+    is_eq = jnp.abs(u_s - l_s) < 1e-12
+    rho = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
+
+    n = A.shape[2]
+    M = (A_s * rho[:, :, None]).swapaxes(1, 2) @ A_s
+    M = M + jnp.eye(n, dtype=dt) * sigma
+    M = M + jax.vmap(jnp.diag)(P_s)
+    L = jnp.linalg.cholesky(M)
+    return QPFactors(L=L, rho=rho, sigma=jnp.asarray(sigma, dt), D=D, E=E,
+                     cost_scale=cost_scale, A_s=A_s, P_s=P_s)
+
+
+def _chol_solve(L, b):
+    """Batched solve M x = b given Cholesky factor L (S,n,n), b (S,n)."""
+    y = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True,
+                                        lower=True, transpose_a=False)
+    x = jax.lax.linalg.triangular_solve(L, y, left_side=True,
+                                        lower=True, transpose_a=True)
+    return x[..., 0]
+
+
+def cold_state(S, n, m, dtype=jnp.float32):
+    z = jnp.zeros((S, m), dtype)
+    return QPState(x=jnp.zeros((S, n), dtype), y=jnp.zeros((S, m), dtype),
+                   z=z, iters=jnp.zeros((), jnp.int32),
+                   pri_res=jnp.full((S,), jnp.inf, dtype),
+                   dua_res=jnp.full((S,), jnp.inf, dtype))
+
+
+@partial(jax.jit, static_argnames=("max_iter", "check_every"))
+def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
+             max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
+             alpha=1.6):
+    """Run ADMM until residuals pass (eps_abs, eps_rel) or max_iter.
+
+    Returns (state, x_unscaled (S,n), y_unscaled (S,m)). `q` is the UNscaled
+    linear cost; scaling uses the cached factors. Warm start by passing the
+    previous state; cold start with `cold_state`.
+    """
+    L, rho, sigma, D, E, cs, A_s, P_s = factors
+    l_s = E * data.l
+    u_s = E * data.u
+    q_s = cs[:, None] * D * q
+    dt = A_s.dtype
+    eps_abs = jnp.asarray(eps_abs, dt)
+    eps_rel = jnp.asarray(eps_rel, dt)
+
+    def admm_iter(carry, _):
+        x, y, z = carry
+        rhs = sigma * x - q_s + (A_s.swapaxes(1, 2) @ ((rho * z - y)[..., None]))[..., 0]
+        x_t = _chol_solve(L, rhs)
+        x_new = alpha * x_t + (1 - alpha) * x
+        z_t = (A_s @ x_t[..., None])[..., 0]
+        z_mix = alpha * z_t + (1 - alpha) * z
+        z_new = jnp.clip(z_mix + y / rho, l_s, u_s)
+        y_new = y + rho * (z_mix - z_new)
+        return (x_new, y_new, z_new), None
+
+    def residuals(x, y, z):
+        Ax = (A_s @ x[..., None])[..., 0]
+        Aty = (A_s.swapaxes(1, 2) @ y[..., None])[..., 0]
+        pri = jnp.max(jnp.abs(Ax - z), axis=1)
+        dua = jnp.max(jnp.abs(P_s * x + q_s + Aty), axis=1)
+        # relative scalings (OSQP-style)
+        pri_sc = jnp.maximum(jnp.max(jnp.abs(Ax), axis=1),
+                             jnp.max(jnp.abs(z), axis=1))
+        dua_sc = jnp.maximum(jnp.max(jnp.abs(P_s * x), axis=1),
+                             jnp.maximum(jnp.max(jnp.abs(q_s), axis=1),
+                                         jnp.max(jnp.abs(Aty), axis=1)))
+        return pri, dua, pri_sc, dua_sc
+
+    def cond(carry):
+        x, y, z, it, done = carry
+        return jnp.logical_and(it < max_iter, jnp.logical_not(done))
+
+    def body(carry):
+        x, y, z, it, _ = carry
+        (x, y, z), _ = jax.lax.scan(admm_iter, (x, y, z), None, length=check_every)
+        pri, dua, pri_sc, dua_sc = residuals(x, y, z)
+        done = jnp.all(jnp.logical_and(pri <= eps_abs + eps_rel * pri_sc,
+                                       dua <= eps_abs + eps_rel * dua_sc))
+        return (x, y, z, it + check_every, done)
+
+    x, y, z, it, _ = jax.lax.while_loop(
+        cond, body, (state.x, state.y, state.z, jnp.zeros((), jnp.int32), jnp.array(False)))
+
+    pri, dua, _, _ = residuals(x, y, z)
+    new_state = QPState(x=x, y=y, z=z, iters=it, pri_res=pri, dua_res=dua)
+    x_un = D * x
+    y_un = cs[:, None] ** -1 * E * y  # unscale duals
+    return new_state, x_un, y_un
+
+
+def qp_objective(data: QPData, q, c0, x):
+    """½xᵀPx + qᵀx + c0 per scenario (unscaled)."""
+    return 0.5 * jnp.sum(data.P_diag * x * x, axis=-1) + jnp.sum(q * x, axis=-1) + c0

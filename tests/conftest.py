@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the stand-in for a TPU slice,
+analogous to the reference testing multi-rank behavior by spawning MPI ranks
+on one machine, ref. examples/afew.py:40-55) with f64 enabled so numerical
+assertions can use tight tolerances. Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
