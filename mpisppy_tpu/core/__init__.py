@@ -1,0 +1,2 @@
+from .spbase import SPBase  # noqa: F401
+from .ef import ExtensiveForm  # noqa: F401

@@ -1,0 +1,95 @@
+"""SPBase: the root runtime object of every algorithm engine / cylinder.
+
+The reference's SPBase (ref. mpisppy/spbase.py:42-114) partitions scenario
+names over MPI ranks, instantiates local Pyomo models, attaches nonant
+bookkeeping, and builds per-tree-node communicators. The TPU redesign holds
+the *entire* scenario batch as device arrays (the scenario axis is a mesh
+axis when sharded; see parallel/), so "partitioning" is a sharding
+annotation rather than object distribution:
+
+- probabilities / nonant indices  -> arrays from the ScenarioBatch
+  (ref. spbase.py:272 _attach_nonant_indices, :353 node probabilities)
+- per-tree-node communicators     -> per-stage membership matmuls
+  (ref. spbase.py:311 _create_communicators)
+- gather_var_values_to_rank0      -> host transfer of the solution block
+  (ref. spbase.py:516)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ir.batch import ScenarioBatch
+from ..ops.qp_solver import QPData, fold_bounds
+
+
+class SPBase:
+    def __init__(self, batch: ScenarioBatch, options=None, dtype=None,
+                 variable_probability=False):
+        self.batch = batch
+        self.options = dict(options or {})
+        self.dtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+        self.spcomm = None  # set by the cylinder layer (ref. spbase.py:503)
+
+        t = self.dtype
+        b = batch
+        self.prob = jnp.asarray(b.prob, t)
+        if not variable_probability and abs(float(b.prob.sum()) - 1.0) > 1e-6:
+            raise ValueError("scenario probabilities must sum to 1 "
+                             "(ref. spbase.py:443 checks)")
+        self.c = jnp.asarray(b.c, t)
+        self.c0 = jnp.asarray(b.c0, t)
+        self.c_stage = jnp.asarray(b.c_stage, t)
+        self.c0_stage = jnp.asarray(b.c0_stage, t)
+        self.nonant_idx = jnp.asarray(b.nonant_idx)
+        self.P_diag = jnp.asarray(b.P_diag, t)
+        self.qp_data: QPData = fold_bounds(
+            self.P_diag, jnp.asarray(b.A, t), jnp.asarray(b.l, t),
+            jnp.asarray(b.u, t), jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
+        # per-stage membership matrices for nonant reductions
+        self.memberships = [jnp.asarray(b.tree.membership(s + 1), t)
+                            for s in range(b.tree.num_stages - 1)]
+        self.slot_slices = b.stage_slot_slices
+
+    # ---- reductions (the reference's Allreduce family) ----
+    def Eobjective(self, obj_per_scen):
+        """Probability-weighted expected objective (ref. phbase.py:279)."""
+        return jnp.dot(self.prob, obj_per_scen)
+
+    def scenario_objectives(self, x):
+        """Per-scenario objective values for a (S, n) solution block."""
+        quad = 0.5 * jnp.sum(self.P_diag * x * x, axis=-1)
+        return quad + jnp.sum(self.c * x, axis=-1) + self.c0
+
+    def compute_xbar(self, xn):
+        """Nonanticipative mean per tree node, broadcast back to scenarios.
+
+        xn: (S, K) nonant slots. Per non-leaf stage t with membership B_t:
+        xbar = B_t (B_tᵀ(p⊙x) / B_tᵀp) — dense matmuls that become
+        local-matmul + psum when the scenario axis is sharded. This replaces
+        the per-node MPI Allreduce in Compute_Xbar (ref. phbase.py:144-221).
+        """
+        outs = []
+        for B, sl in zip(self.memberships, self.slot_slices):
+            xt = xn[:, sl]
+            pnode = B.T @ self.prob
+            num = B.T @ (self.prob[:, None] * xt)
+            outs.append(B @ (num / pnode[:, None]))
+        return jnp.concatenate(outs, axis=1)
+
+    def nonants_of(self, x):
+        return x[..., self.nonant_idx]
+
+    # ---- reporting (ref. spbase.py:516-576) ----
+    def gather_var_values(self, x):
+        """Host-side dict {var_name: (S, size) ndarray}."""
+        xh = np.asarray(x)
+        return {name: xh[:, sl] for name, sl in self.batch.template.var_slices.items()}
+
+    def report_var_values(self, x, max_rows=20):
+        vals = self.gather_var_values(x)
+        for name, arr in vals.items():
+            print(f"{name}: shape {arr.shape}")
+            print(arr[:max_rows])

@@ -1,0 +1,49 @@
+"""Farmer EF reproduces the reference's known objective.
+
+The 3-scenario farmer's stochastic-program optimum is -108390 (profit
+108389.99...), the value asserted throughout the reference's test suite and
+docs (ref. mpisppy/tests/test_ef_ph.py round_pos_sig checks).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.models import farmer
+
+
+def test_farmer_ef_objective():
+    tree = farmer.make_tree(3)
+    batch = build_batch(farmer.scenario_creator, tree)
+    assert batch.S == 3 and batch.K == 3
+    ef = ExtensiveForm(batch)
+    obj, x_batch = ef.solve_extensive_form()
+    assert obj == pytest.approx(-108390.0, rel=2e-4)
+    # known optimal acreage: wheat 170, corn 80, sugar beets 250
+    root = ef.get_root_solution()
+    assert root == pytest.approx([170.0, 80.0, 250.0], abs=0.5)
+    # nonants must agree across scenarios by construction
+    nons = x_batch[:, batch.nonant_idx]
+    assert np.allclose(nons, nons[0], atol=1e-9)
+
+
+def test_farmer_ef_more_scenarios():
+    # 30 scenarios with yield noise: objective just needs to be finite and
+    # in the plausible band; primarily a structure/stacking test
+    tree = farmer.make_tree(30)
+    batch = build_batch(farmer.scenario_creator, tree)
+    ef = ExtensiveForm(batch)
+    obj, _ = ef.solve_extensive_form()
+    assert -140000 < obj < -90000
+
+
+def test_farmer_scalable_multiplier():
+    tree = farmer.make_tree(3)
+    batch = build_batch(farmer.scenario_creator, tree,
+                        creator_kwargs={"crops_multiplier": 2})
+    assert batch.n == 4 * 6  # 4 var blocks x 6 crops
+    ef = ExtensiveForm(batch)
+    obj, _ = ef.solve_extensive_form()
+    # doubling crops doubles the optimum
+    assert obj == pytest.approx(2 * -108390.0, rel=2e-4)
