@@ -1,0 +1,60 @@
+"""PH on farmer: trivial bound, convergence, and agreement with the EF."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH
+from mpisppy_tpu.models import farmer
+
+EF_OBJ = -108390.0
+WS_BOUND = -115405.56  # wait-and-see bound of the 3-scenario farmer
+
+
+def _make_ph(num_scens=3, **opts):
+    tree = farmer.make_tree(num_scens)
+    batch = build_batch(farmer.scenario_creator, tree)
+    options = {"defaultPHrho": 1.0, "PHIterLimit": 100, "convthresh": 1e-7,
+               "subproblem_max_iter": 4000}
+    options.update(opts)
+    return PH(batch, options)
+
+
+def test_ph_iter0_trivial_bound():
+    ph = _make_ph(PHIterLimit=0)
+    conv, eobj, tbound = ph.ph_main()
+    # iter0 solves with no W/prox give the wait-and-see bound
+    assert tbound == pytest.approx(WS_BOUND, rel=1e-4)
+    assert tbound <= EF_OBJ + 1.0
+
+
+def test_ph_converges_toward_ef():
+    ph = _make_ph(PHIterLimit=150, defaultPHrho=1.0)
+    conv, eobj, tbound = ph.ph_main()
+    # xbar should approach the EF first-stage solution
+    xbar = np.asarray(ph.xbar[0])
+    assert xbar == pytest.approx([170.0, 80.0, 250.0], abs=2.0)
+    # the converged expected objective is near the EF optimum
+    assert eobj == pytest.approx(EF_OBJ, rel=2e-3)
+    assert conv < 1e-2
+
+
+def test_ph_w_sums_to_zero():
+    ph = _make_ph(PHIterLimit=5)
+    ph.ph_main()
+    # dual feasibility invariant: E[W] = 0 per nonant slot
+    W = np.asarray(ph.W)
+    p = np.asarray(ph.prob)
+    assert np.allclose(p @ W, 0.0, atol=1e-6)
+
+
+def test_ph_lagrangian_bound_from_ws():
+    # after some PH iterations, solving with W on / prox off gives a valid
+    # Lagrangian lower bound >= the trivial (WS) bound (and <= EF optimum)
+    ph = _make_ph(PHIterLimit=30)
+    ph.ph_main()
+    ph.solve_loop(w_on=True, prox_on=False, update=False)
+    lag = ph.Ebound()
+    assert lag <= EF_OBJ + 1.0
+    assert lag >= WS_BOUND - 1.0
