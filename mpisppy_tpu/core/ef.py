@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import QPData, fold_bounds, qp_setup, qp_solve, cold_state
+from ..ops.qp_solver import QPData, fold_bounds, qp_setup, qp_solve, qp_cold_state
 from .spbase import SPBase
 
 
@@ -94,9 +94,8 @@ class ExtensiveForm(SPBase):
     def solve_extensive_form(self, max_iter=40000, eps_abs=1e-7, eps_rel=1e-7):
         """Solve the EF; mirrors opt/ef.py:61. Returns (objective, x_batch)
         where x_batch is the per-scenario (S, n) solution block."""
-        factors = qp_setup(self.ef_data)
-        S1, m_ef, n_ef = self.ef_data.A.shape
-        st = cold_state(1, n_ef, m_ef, dtype=self.ef_data.A.dtype)
+        factors = qp_setup(self.ef_data, q_ref=self.c_ef)
+        st = qp_cold_state(factors)
         st, x_ef, _ = qp_solve(factors, self.ef_data, self.c_ef, st,
                                max_iter=max_iter, eps_abs=eps_abs, eps_rel=eps_rel)
         self.solver_state = st

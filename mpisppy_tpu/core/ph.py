@@ -35,21 +35,24 @@ import numpy as np
 
 from .. import global_toc
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import QPData, qp_setup, qp_solve, cold_state
+from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_cold_state,
+                             qp_dual_objective)
 from .spbase import SPBase
 
 
 class PHBase(SPBase):
     def __init__(self, batch: ScenarioBatch, options=None, rho_setter=None,
-                 extensions=None, converger=None, dtype=None):
-        super().__init__(batch, options, dtype)
+                 extensions=None, converger=None, dtype=None, mesh=None):
+        super().__init__(batch, options, dtype, mesh=mesh)
+        batch = self.batch  # possibly mesh-padded
         opts = self.options
         self.rho_default = float(opts.get("defaultPHrho", 1.0))
         self.max_iterations = int(opts.get("PHIterLimit", 100))
         self.convthresh = float(opts.get("convthresh", 1e-4))
         self.verbose = bool(opts.get("verbose", False))
-        self.sub_max_iter = int(opts.get("subproblem_max_iter", 2000))
-        self.sub_eps = float(opts.get("subproblem_eps", 1e-6))
+        self.sub_max_iter = int(opts.get("subproblem_max_iter", 5000))
+        # 1e-8 keeps the dual-objective bounds tight (f64); loosen on f32
+        self.sub_eps = float(opts.get("subproblem_eps", 1e-8))
         self.rho_setter = rho_setter
         self.extensions = extensions
         self.converger_cls = converger
@@ -66,13 +69,19 @@ class PHBase(SPBase):
         self.W = jnp.zeros((S, K), t)
         self.xbar = jnp.zeros((S, K), t)
         self.xsqbar = jnp.zeros((S, K), t)
+        if mesh is not None:
+            from ..parallel.mesh import scenario_sharding
+            sh = scenario_sharding(mesh, 2)
+            self.rho, self.W, self.xbar, self.xsqbar = (
+                jax.device_put(a, sh) for a in (self.rho, self.W, self.xbar,
+                                                self.xsqbar))
         self.x = None            # (S, n) latest subproblem solutions
         self.conv = None
         self._iter = 0
         self.best_bound = -jnp.inf  # outer (lower, for min) bound
 
         self._factors = {}       # prox_on -> QPFactors
-        self._qp_state = None
+        self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
         self._fixed_mask = jnp.zeros((S, K), bool)   # fixer/xhat support
         self._fixed_vals = jnp.zeros((S, K), t)
         self._step_fns = {}
@@ -88,20 +97,26 @@ class PHBase(SPBase):
         """Cached per-prox-toggle factorization (invalidated on rho change)."""
         key = bool(prox_on)
         if key not in self._factors:
-            self._factors[key] = qp_setup(self._data_with_prox(key))
+            self._factors[key] = qp_setup(self._data_with_prox(key), q_ref=self.c)
         return self._factors[key]
 
     def invalidate_factors(self):
         """Call after changing rho (rho setters / NormRhoUpdater)."""
         self._factors.pop(True, None)
+        self._qp_states.pop(True, None)
         self._step_fns.clear()
 
-    def _ensure_state(self):
-        if self._qp_state is None:
-            S = self.batch.S
-            m = self.qp_data.A.shape[1]
-            self._qp_state = cold_state(S, self.qp_data.A.shape[2], m,
-                                        dtype=self.qp_data.A.dtype)
+    def _ensure_state(self, prox_on=True):
+        """Per-mode solver state (the KKT factor depends on the prox term);
+        x/y/z warm-start across modes."""
+        key = bool(prox_on)
+        if key not in self._qp_states:
+            st = qp_cold_state(self._get_factors(key))
+            other = self._qp_states.get(not key)
+            if other is not None:
+                st = st._replace(x=other.x, y=other.y, z=other.z)
+            self._qp_states[key] = st
+        return self._qp_states[key]
 
     # ------------- the fused PH step -------------
     def _make_step(self, w_on: bool, prox_on: bool):
@@ -137,8 +152,11 @@ class PHBase(SPBase):
             base_obj = jnp.sum(c * x, axis=1) + c0 \
                 + 0.5 * jnp.sum(self.P_diag * x * x, axis=1)
             solved_obj = base_obj + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
+            # certified lower bound on each subproblem's optimum (valid for
+            # prox-off solves; see qp_dual_objective)
+            dual_obj = qp_dual_objective(d, q, c0, y, mA, x_witness=x)
             return qp_state, x, y, xn, xbar_new, xsqbar_new, W_new, conv, \
-                base_obj, solved_obj
+                base_obj, solved_obj, dual_obj
 
         return step
 
@@ -153,11 +171,13 @@ class PHBase(SPBase):
         (ref. phbase.py:999) + Compute_Xbar + Update_W fused. Returns the
         per-scenario *solved* objective (including the W term when w_on,
         which is what Ebound of a Lagrangian pass needs)."""
-        self._ensure_state()
+        qp_state = self._ensure_state(prox_on)
         step = self._step(w_on, prox_on)
-        (self._qp_state, x, y, xn, xbar_new, xsqbar_new, W_new, conv,
-         base_obj, solved_obj) = step(self._qp_state, self.W, self.xbar,
-                                      self.rho, self._fixed_mask, self._fixed_vals)
+        (qp_state, x, y, xn, xbar_new, xsqbar_new, W_new, conv,
+         base_obj, solved_obj, dual_obj) = step(qp_state, self.W, self.xbar,
+                                                self.rho, self._fixed_mask,
+                                                self._fixed_vals)
+        self._qp_states[bool(prox_on)] = qp_state
         self.x, self.y = x, y
         if update:
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
@@ -165,6 +185,7 @@ class PHBase(SPBase):
             self.conv = float(conv)
         self._last_base_obj = base_obj
         self._last_solved_obj = solved_obj
+        self._last_dual_obj = dual_obj
         return solved_obj
 
     # ------------- reference-named primitives -------------
@@ -178,9 +199,12 @@ class PHBase(SPBase):
         self.W = self.W + self.rho * (xn - self.xbar)
 
     def Ebound(self):
-        """Expected solved objective = a lower bound when subproblems were
-        solved to optimality with a dual-feasible W (ref. phbase.py:314)."""
-        return float(self.Eobjective(self._last_solved_obj))
+        """Expected certified subproblem lower bound (ref. phbase.py:314
+        Ebound). Built from the ADMM dual vectors, NOT the primal
+        objectives — an inexact primal solve over-estimates the minimum and
+        would produce an invalid outer bound. Meaningful for prox-off
+        solves (trivial bound, Lagrangian spokes)."""
+        return float(self.Eobjective(self._last_dual_obj))
 
     def Eobjective_value(self):
         return float(self.Eobjective(self._last_base_obj))
@@ -214,7 +238,7 @@ class PH(PHBase):
         # Iter 0: no W, no prox (ref. phbase.py:1364 Iter0)
         self.solve_loop(w_on=False, prox_on=False)
         self.Update_W()  # W was zero, so W = rho(x - xbar)
-        self.trivial_bound = self.Eobjective_value()
+        self.trivial_bound = self.Ebound()  # certified wait-and-see bound
         self.best_bound = self.trivial_bound
         self._iter = 0
         self._ext("post_iter0")

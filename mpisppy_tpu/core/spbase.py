@@ -27,7 +27,16 @@ from ..ops.qp_solver import QPData, fold_bounds
 
 class SPBase:
     def __init__(self, batch: ScenarioBatch, options=None, dtype=None,
-                 variable_probability=False):
+                 variable_probability=False, mesh=None):
+        """`mesh`: optional jax Mesh whose first axis shards the scenario
+        dimension of every batch tensor (see parallel/mesh.py). When given,
+        the batch is zero-probability-padded to the mesh size and all
+        jitted engine steps compile to SPMD programs with XLA-chosen
+        collectives for the nonant reductions."""
+        if mesh is not None:
+            from ..parallel.mesh import pad_batch_for_mesh
+            batch, self._S_orig = pad_batch_for_mesh(batch, mesh.devices.size)
+        self.mesh = mesh
         self.batch = batch
         self.options = dict(options or {})
         self.dtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
@@ -52,6 +61,18 @@ class SPBase:
         self.memberships = [jnp.asarray(b.tree.membership(s + 1), t)
                             for s in range(b.tree.num_stages - 1)]
         self.slot_slices = b.stage_slot_slices
+
+        if mesh is not None:
+            from ..parallel.mesh import scenario_sharding
+            shard = lambda a: jax.device_put(a, scenario_sharding(mesh, a.ndim))
+            self.prob = shard(self.prob)
+            self.c = shard(self.c)
+            self.c0 = shard(self.c0)
+            self.c_stage = shard(self.c_stage)
+            self.c0_stage = shard(self.c0_stage)
+            self.P_diag = shard(self.P_diag)
+            self.qp_data = type(self.qp_data)(*[shard(a) for a in self.qp_data])
+            self.memberships = [shard(B) for B in self.memberships]
 
     # ---- reductions (the reference's Allreduce family) ----
     def Eobjective(self, obj_per_scen):

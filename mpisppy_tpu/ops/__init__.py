@@ -1,1 +1,2 @@
-from .qp_solver import QPData, QPFactors, QPState, qp_setup, qp_solve, fold_bounds  # noqa: F401
+from .qp_solver import (QPData, QPFactors, QPState, qp_setup, qp_solve,  # noqa: F401
+                        qp_cold_state, fold_bounds, qp_objective)

@@ -13,12 +13,14 @@ Form:   min ½ xᵀ diag(P) x + qᵀx   s.t.  l ≤ A x ≤ u
 (variable bounds are folded into A as identity rows by ``fold_bounds``).
 
 Method: ADMM as in OSQP (Stellato et al. 2020) with
- - Ruiz equilibration of the KKT matrix for conditioning,
- - per-row stepsize rho (boosted on equality rows),
- - a cached dense Cholesky factor of M = diag(P) + σI + Aᵀdiag(ρ)A — the key
-   PH synergy: PH iterations change only q (W and the prox center x̄), so the
-   factorization amortizes across the entire PH run,
- - warm starting from the previous (x, y, z),
+ - Ruiz equilibration of the KKT matrix plus cost normalization,
+ - per-row stepsize rho (boosted on equality rows) with OSQP's adaptive
+   rho rule: rho <- rho * sqrt(rel_pri_res / rel_dua_res), refactorizing
+   the KKT matrix inside the solve loop when the change exceeds 5x,
+ - a dense Cholesky factor of M = diag(P) + sigma*I + A'diag(rho)A carried
+   in the *solver state*: PH iterations change only q (W and the prox
+   center x-bar), so both the factor and the adapted rho persist across
+   warm-started solves and refactorization becomes rare at steady state,
  - periodic residual checks inside a lax.while_loop (compiler-friendly
    control flow; no Python in the loop).
 
@@ -35,7 +37,6 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 class QPData(NamedTuple):
@@ -47,22 +48,24 @@ class QPData(NamedTuple):
 
 
 class QPFactors(NamedTuple):
-    """Setup artifacts reused across solves with different q."""
-    L: jax.Array        # (S, n, n) Cholesky factor of M
-    rho: jax.Array      # (S, m) per-row stepsize
-    sigma: jax.Array    # scalar
-    D: jax.Array        # (S, n) column equilibration
-    E: jax.Array        # (S, m) row equilibration
+    """Static setup artifacts (scaling + scaled matrices)."""
+    sigma: jax.Array       # scalar
+    D: jax.Array           # (S, n) column equilibration
+    E: jax.Array           # (S, m) row equilibration
     cost_scale: jax.Array  # (S,) objective scaling
-    A_s: jax.Array      # (S, m, n) scaled A
-    P_s: jax.Array      # (S, n) scaled P diagonal
+    A_s: jax.Array         # (S, m, n) scaled A
+    P_s: jax.Array         # (S, n) scaled P diagonal
+    rho_pattern: jax.Array  # (S, m) relative per-row rho (eq rows boosted)
 
 
 class QPState(NamedTuple):
+    """Warm-startable solver state; L and rho persist across solves."""
     x: jax.Array        # (S, n) scaled iterate
     y: jax.Array        # (S, m) scaled dual
     z: jax.Array        # (S, m) scaled slack
-    iters: jax.Array    # (S,) or scalar total iterations run
+    L: jax.Array        # (S, n, n) Cholesky factor of current KKT matrix
+    rho_scale: jax.Array  # (S,) scalar multiplier on rho_pattern
+    iters: jax.Array    # scalar total ADMM iterations in last solve
     pri_res: jax.Array  # (S,)
     dua_res: jax.Array  # (S,)
 
@@ -80,11 +83,8 @@ def fold_bounds(P_diag, A, l, u, lb, ub):
 
 
 def _ruiz_equilibrate(P_diag, A, iters=15):
-    """Modified Ruiz equilibration of the KKT matrix [[P, Aᵀ],[A, 0]].
-
-    Returns (D, E) with scaled P̄ = D P D (diag), Ā = E A D, all batched.
-    Infinite bounds are untouched (they scale to ±inf harmlessly).
-    """
+    """Modified Ruiz equilibration of the KKT matrix [[P, A'],[A, 0]].
+    Returns (D, E) with scaled P = D P D (diag), A = E A D, all batched."""
     S, m, n = A.shape
     D = jnp.ones((S, n), A.dtype)
     E = jnp.ones((S, m), A.dtype)
@@ -93,46 +93,62 @@ def _ruiz_equilibrate(P_diag, A, iters=15):
         D, E = DE
         As = E[:, :, None] * A * D[:, None, :]
         Ps = D * P_diag * D
-        # column norms of the KKT block column for x: max(|Ps|, colmax|As|)
         cnorm = jnp.maximum(jnp.abs(Ps), jnp.max(jnp.abs(As), axis=1))
         rnorm = jnp.max(jnp.abs(As), axis=2)
-        d = 1.0 / jnp.sqrt(jnp.maximum(cnorm, 1e-8))
-        e = 1.0 / jnp.sqrt(jnp.maximum(rnorm, 1e-8))
-        # guard empty rows/cols
-        d = jnp.where(cnorm < 1e-12, 1.0, d)
-        e = jnp.where(rnorm < 1e-12, 1.0, e)
+        d = jnp.where(cnorm < 1e-12, 1.0, 1.0 / jnp.sqrt(jnp.maximum(cnorm, 1e-12)))
+        e = jnp.where(rnorm < 1e-12, 1.0, 1.0 / jnp.sqrt(jnp.maximum(rnorm, 1e-12)))
         return D * d, E * e
 
     D, E = jax.lax.fori_loop(0, iters, body, (D, E))
     return D, E
 
 
+def _factorize(factors: QPFactors, rho_scale):
+    """Batched Cholesky of M = diag(P_s) + sigma I + A_s' diag(rho) A_s."""
+    A_s, P_s = factors.A_s, factors.P_s
+    rho = factors.rho_pattern * rho_scale[:, None]
+    n = A_s.shape[2]
+    M = (A_s * rho[:, :, None]).swapaxes(1, 2) @ A_s
+    M = M + jnp.eye(n, dtype=A_s.dtype) * factors.sigma
+    M = M + jax.vmap(jnp.diag)(P_s)
+    return jnp.linalg.cholesky(M)
+
+
 @partial(jax.jit, static_argnames=("eq_boost",))
-def qp_setup(data: QPData, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
-    """Equilibrate, choose per-row rho, factor M. O(S·n³) once per problem
-    (and once per PH rho change); solves reuse the factor."""
+def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
+    """Equilibrate and scale. O(S n^2) + one batched n^3 Cholesky in
+    qp_cold_state; re-solves with new q reuse everything."""
     P_diag, A, l, u = data
     dt = A.dtype
     D, E = _ruiz_equilibrate(P_diag, A)
     A_s = E[:, :, None] * A * D[:, None, :]
     P_s = D * P_diag * D
-    l_s = E * l
-    u_s = E * u
-    # cost scaling: normalize scaled gradient magnitude ~ 1 (OSQP uses
-    # 1/max(mean col norms); a cheap robust proxy here)
-    cost_scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(P_s), axis=1), 1.0)
+    # cost normalization (OSQP sec 5.1): scale so the objective gradient is O(1)
+    if q_ref is None:
+        q_ref = jnp.zeros_like(P_diag)
+    qs = D * q_ref
+    gnorm = jnp.maximum(jnp.max(jnp.abs(P_s), axis=1), jnp.max(jnp.abs(qs), axis=1))
+    cost_scale = 1.0 / jnp.maximum(gnorm, 1.0)
     P_s = P_s * cost_scale[:, None]
 
-    is_eq = jnp.abs(u_s - l_s) < 1e-12
-    rho = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
+    is_eq = jnp.abs(E * u - E * l) < 1e-12
+    rho_pattern = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
+    return QPFactors(sigma=jnp.asarray(sigma, dt), D=D, E=E,
+                     cost_scale=cost_scale, A_s=A_s, P_s=P_s,
+                     rho_pattern=rho_pattern)
 
-    n = A.shape[2]
-    M = (A_s * rho[:, :, None]).swapaxes(1, 2) @ A_s
-    M = M + jnp.eye(n, dtype=dt) * sigma
-    M = M + jax.vmap(jnp.diag)(P_s)
-    L = jnp.linalg.cholesky(M)
-    return QPFactors(L=L, rho=rho, sigma=jnp.asarray(sigma, dt), D=D, E=E,
-                     cost_scale=cost_scale, A_s=A_s, P_s=P_s)
+
+@jax.jit
+def qp_cold_state(factors: QPFactors) -> QPState:
+    S, m, n = factors.A_s.shape
+    dt = factors.A_s.dtype
+    rho_scale = jnp.ones((S,), dt)
+    L = _factorize(factors, rho_scale)
+    z = jnp.zeros((S, m), dt)
+    return QPState(x=jnp.zeros((S, n), dt), y=jnp.zeros((S, m), dt), z=z,
+                   L=L, rho_scale=rho_scale, iters=jnp.zeros((), jnp.int32),
+                   pri_res=jnp.full((S,), jnp.inf, dt),
+                   dua_res=jnp.full((S,), jnp.inf, dt))
 
 
 def _chol_solve(L, b):
@@ -144,25 +160,17 @@ def _chol_solve(L, b):
     return x[..., 0]
 
 
-def cold_state(S, n, m, dtype=jnp.float32):
-    z = jnp.zeros((S, m), dtype)
-    return QPState(x=jnp.zeros((S, n), dtype), y=jnp.zeros((S, m), dtype),
-                   z=z, iters=jnp.zeros((), jnp.int32),
-                   pri_res=jnp.full((S,), jnp.inf, dtype),
-                   dua_res=jnp.full((S,), jnp.inf, dtype))
-
-
-@partial(jax.jit, static_argnames=("max_iter", "check_every"))
+@partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho"))
 def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
              max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
-             alpha=1.6):
+             alpha=1.6, adaptive_rho=True):
     """Run ADMM until residuals pass (eps_abs, eps_rel) or max_iter.
 
     Returns (state, x_unscaled (S,n), y_unscaled (S,m)). `q` is the UNscaled
-    linear cost; scaling uses the cached factors. Warm start by passing the
-    previous state; cold start with `cold_state`.
+    linear cost. Warm start by passing the previous state (its adapted rho
+    and factor carry over); cold start with `qp_cold_state(factors)`.
     """
-    L, rho, sigma, D, E, cs, A_s, P_s = factors
+    sigma, D, E, cs, A_s, P_s, rho_pattern = factors
     l_s = E * data.l
     u_s = E * data.u
     q_s = cs[:, None] * D * q
@@ -170,52 +178,157 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
     eps_abs = jnp.asarray(eps_abs, dt)
     eps_rel = jnp.asarray(eps_rel, dt)
 
-    def admm_iter(carry, _):
-        x, y, z = carry
-        rhs = sigma * x - q_s + (A_s.swapaxes(1, 2) @ ((rho * z - y)[..., None]))[..., 0]
-        x_t = _chol_solve(L, rhs)
-        x_new = alpha * x_t + (1 - alpha) * x
-        z_t = (A_s @ x_t[..., None])[..., 0]
-        z_mix = alpha * z_t + (1 - alpha) * z
-        z_new = jnp.clip(z_mix + y / rho, l_s, u_s)
-        y_new = y + rho * (z_mix - z_new)
-        return (x_new, y_new, z_new), None
+    def admm_chunk(x, y, z, L, rho):
+        def one(carry, _):
+            x, y, z = carry
+            rhs = sigma * x - q_s + (A_s.swapaxes(1, 2) @ ((rho * z - y)[..., None]))[..., 0]
+            x_t = _chol_solve(L, rhs)
+            x_new = alpha * x_t + (1 - alpha) * x
+            z_t = (A_s @ x_t[..., None])[..., 0]
+            z_mix = alpha * z_t + (1 - alpha) * z
+            z_new = jnp.clip(z_mix + y / rho, l_s, u_s)
+            y_new = y + rho * (z_mix - z_new)
+            return (x_new, y_new, z_new), None
+
+        (x, y, z), _ = jax.lax.scan(one, (x, y, z), None, length=check_every)
+        return x, y, z
 
     def residuals(x, y, z):
+        """UNSCALED residuals (OSQP's default termination convention): the
+        scaled ones can be orders of magnitude smaller than problem-unit
+        errors, which would poison the dual-objective bounds."""
         Ax = (A_s @ x[..., None])[..., 0]
         Aty = (A_s.swapaxes(1, 2) @ y[..., None])[..., 0]
-        pri = jnp.max(jnp.abs(Ax - z), axis=1)
-        dua = jnp.max(jnp.abs(P_s * x + q_s + Aty), axis=1)
-        # relative scalings (OSQP-style)
-        pri_sc = jnp.maximum(jnp.max(jnp.abs(Ax), axis=1),
-                             jnp.max(jnp.abs(z), axis=1))
-        dua_sc = jnp.maximum(jnp.max(jnp.abs(P_s * x), axis=1),
-                             jnp.maximum(jnp.max(jnp.abs(q_s), axis=1),
-                                         jnp.max(jnp.abs(Aty), axis=1)))
+        Einv = 1.0 / E
+        Dinv_c = 1.0 / (D * cs[:, None])
+        pri = jnp.max(jnp.abs(Einv * (Ax - z)), axis=1)
+        dua = jnp.max(jnp.abs(Dinv_c * (P_s * x + q_s + Aty)), axis=1)
+        pri_sc = jnp.maximum(jnp.maximum(jnp.max(jnp.abs(Einv * Ax), axis=1),
+                                         jnp.max(jnp.abs(Einv * z), axis=1)), 1e-6)
+        dua_sc = jnp.maximum(jnp.maximum(
+            jnp.max(jnp.abs(Dinv_c * P_s * x), axis=1),
+            jnp.maximum(jnp.max(jnp.abs(Dinv_c * q_s), axis=1),
+                        jnp.max(jnp.abs(Dinv_c * Aty), axis=1))), 1e-6)
         return pri, dua, pri_sc, dua_sc
 
     def cond(carry):
-        x, y, z, it, done = carry
+        *_, it, done = carry
         return jnp.logical_and(it < max_iter, jnp.logical_not(done))
 
     def body(carry):
-        x, y, z, it, _ = carry
-        (x, y, z), _ = jax.lax.scan(admm_iter, (x, y, z), None, length=check_every)
+        x, y, z, L, rho_scale, it, _ = carry
+        rho = rho_pattern * rho_scale[:, None]
+        x, y, z = admm_chunk(x, y, z, L, rho)
         pri, dua, pri_sc, dua_sc = residuals(x, y, z)
         done = jnp.all(jnp.logical_and(pri <= eps_abs + eps_rel * pri_sc,
                                        dua <= eps_abs + eps_rel * dua_sc))
-        return (x, y, z, it + check_every, done)
+        if adaptive_rho:
+            # OSQP-style infrequent adaptation: every 4th residual check, and
+            # only scenarios whose ideal rho moved by > 5x adopt the new
+            # value (per-scenario; adapting all on any trigger thrashes)
+            adapt_now = ((it // check_every) % 4) == 3
+            ratio = jnp.sqrt((pri / pri_sc) / jnp.maximum(dua / dua_sc, 1e-30))
+            new_scale = jnp.clip(rho_scale * ratio, 1e-6, 1e6)
+            change = jnp.maximum(new_scale / rho_scale, rho_scale / new_scale)
+            mask = (change > 5.0) & adapt_now & jnp.logical_not(done)
+            rho_scale = jnp.where(mask, new_scale, rho_scale)
+            need = jnp.any(mask)
+            L = jax.lax.cond(need, lambda: _factorize(factors, rho_scale),
+                             lambda: L)
+        return (x, y, z, L, rho_scale, it + check_every, done)
 
-    x, y, z, it, _ = jax.lax.while_loop(
-        cond, body, (state.x, state.y, state.z, jnp.zeros((), jnp.int32), jnp.array(False)))
+    x, y, z, L, rho_scale, it, _ = jax.lax.while_loop(
+        cond, body,
+        (state.x, state.y, state.z, state.L, state.rho_scale,
+         jnp.zeros((), jnp.int32), jnp.array(False)))
 
     pri, dua, _, _ = residuals(x, y, z)
-    new_state = QPState(x=x, y=y, z=z, iters=it, pri_res=pri, dua_res=dua)
+    new_state = QPState(x=x, y=y, z=z, L=L, rho_scale=rho_scale, iters=it,
+                        pri_res=pri, dua_res=dua)
     x_un = D * x
-    y_un = cs[:, None] ** -1 * E * y  # unscale duals
+    y_un = (1.0 / cs[:, None]) * E * y  # unscale duals
     return new_state, x_un, y_un
 
 
 def qp_objective(data: QPData, q, c0, x):
-    """½xᵀPx + qᵀx + c0 per scenario (unscaled)."""
+    """½x'Px + q'x + c0 per scenario (unscaled)."""
     return 0.5 * jnp.sum(data.P_diag * x * x, axis=-1) + jnp.sum(q * x, axis=-1) + c0
+
+
+def _boxmin(P, r, lb, ub):
+    """Coordinate-wise min of ½P x² + r x over [lb, ub] (P >= 0 diagonal).
+    Returns -inf where a linear piece descends toward an infinite bound."""
+    x_unc = jnp.where(P > 0, -r / jnp.where(P > 0, P, 1.0), 0.0)
+    x_star = jnp.clip(x_unc, lb, ub)
+    quad_val = 0.5 * P * x_star * x_star + r * x_star
+    lin_lo = jnp.where(r > 0, jnp.where(jnp.isneginf(lb), -jnp.inf, r * lb), 0.0)
+    lin_hi = jnp.where(r < 0, jnp.where(jnp.isposinf(ub), -jnp.inf, r * ub), 0.0)
+    return jnp.where(P > 0, quad_val, lin_lo + lin_hi)
+
+
+def qp_dual_objective(data: QPData, q, c0, y, n_rows, x_witness=None,
+                      r_rel_tol=1e-6):
+    """Per-scenario LOWER bound on min ½x'Px + q'x + c0 s.t. l <= Ax <= u,
+    lb <= x <= ub, from an (approximately) dual-feasible y.
+
+    An inexact *primal* solution over-estimates the subproblem minimum, so
+    bounds built from primal objectives (what the reference gets for free
+    from its exact MIP solver, ref. phbase.py:314 Ebound) would be invalid
+    here. Instead evaluate a Lagrangian dual at y. With y split into
+    constraint-row duals y_c (first n_rows rows) and folded bound-row duals
+    y_b, *any* choice of bound-row duals yields a valid bound when x is also
+    kept in its box, so per coordinate we take the better of:
+
+      (a) keep y_b_j:  boxmin(½Px² + r_j x) - (ub_j y_bj+ - lb_j y_bj-)
+          with r = q + A'y the full dual residual, entries below
+          r_rel_tol*max(1,|q_j|) zeroed (epsilon-valid convention), and
+      (b) drop y_b_j:  boxmin(½Px² + (r_j - y_bj) x)   [pure reduced cost]
+
+    plus, where both are -inf (an infinite-direction residual above
+    tolerance), a witness fallback: clamp the offending residual part and
+    pay |clamped|*(2|x_witness_j| + 1) — valid whenever the true optimum
+    satisfies |x*_j| <= 2|x_witness_j| + 1.
+
+    The total is  -sup_c + sum_j best_j + c0  with
+    sup_c = u_c'y_c+ - l_c'y_c- over constraint rows only.
+    """
+    S, m, n = data.A.shape
+    lb = data.l[..., n_rows:]
+    ub = data.u[..., n_rows:]
+    y_c = y[..., :n_rows]
+    y_b = y[..., n_rows:]
+    P = data.P_diag
+
+    r = q + (data.A.swapaxes(-1, -2) @ y[..., None])[..., 0]
+    tol = r_rel_tol * jnp.maximum(1.0, jnp.abs(q))
+    r_a = jnp.where(jnp.abs(r) <= tol, 0.0, r)
+
+    ybp = jnp.maximum(y_b, 0.0)
+    ybm = jnp.maximum(-y_b, 0.0)
+    ub_fin = jnp.where(jnp.isfinite(ub), ub, 0.0)
+    lb_fin = jnp.where(jnp.isfinite(lb), lb, 0.0)
+    sup_b = ub_fin * ybp - lb_fin * ybm \
+        + jnp.where((jnp.isposinf(ub) & (ybp > 1e-9))
+                    | (jnp.isneginf(lb) & (ybm > 1e-9)), jnp.inf, 0.0)
+    contrib_a = _boxmin(P, r_a, lb, ub) - sup_b
+    contrib_b = _boxmin(P, r - y_b, lb, ub)
+    best = jnp.maximum(contrib_a, contrib_b)
+
+    if x_witness is not None:
+        r_fix = jnp.where(jnp.isposinf(ub) & (r_a < 0), 0.0, r_a)
+        r_fix = jnp.where(jnp.isneginf(lb) & (r_fix > 0), 0.0, r_fix)
+        penalty = jnp.abs(r_a - r_fix) * (2.0 * jnp.abs(x_witness) + 1.0)
+        fallback = _boxmin(P, r_fix, lb, ub) - sup_b - penalty
+        best = jnp.maximum(best, jnp.where(jnp.isneginf(best), fallback, best))
+
+    ycp = jnp.maximum(y_c, 0.0)
+    ycm = jnp.maximum(-y_c, 0.0)
+    uc = data.u[..., :n_rows]
+    lc = data.l[..., :n_rows]
+    uc_fin = jnp.where(jnp.isfinite(uc), uc, 0.0)
+    lc_fin = jnp.where(jnp.isfinite(lc), lc, 0.0)
+    sup_c = jnp.sum(uc_fin * ycp - lc_fin * ycm, axis=-1) \
+        + jnp.sum(jnp.where((jnp.isposinf(uc) & (ycp > 1e-9))
+                            | (jnp.isneginf(lc) & (ycm > 1e-9)), jnp.inf, 0.0),
+                  axis=-1)
+    return jnp.sum(best, axis=-1) - sup_c + c0

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, shard_arrays, scenario_sharding  # noqa: F401

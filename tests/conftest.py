@@ -3,16 +3,15 @@
 Tests run on a virtual 8-device CPU mesh (the stand-in for a TPU slice,
 analogous to the reference testing multi-rank behavior by spawning MPI ranks
 on one machine, ref. examples/afew.py:40-55) with f64 enabled so numerical
-assertions can use tight tolerances. Must run before jax is imported.
+assertions can use tight tolerances. Note: under the axon TPU tunnel the
+JAX_PLATFORMS env var is ignored, so the platform must be forced through
+jax.config before any computation runs.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,62 @@
+"""Hydro (3-stage): EF objective, PH trivial bound, multistage nonant logic.
+
+Reference assertions: trivial bound rounds to 180 and PH Eobjective to 190
+at 2 significant digits (ref. mpisppy/tests/test_ef_ph.py:554-559).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH
+from mpisppy_tpu.models import hydro
+
+
+def _batch():
+    tree = hydro.make_tree((3, 3))
+    return build_batch(hydro.scenario_creator, tree)
+
+
+def round_pos_sig(x, sig=2):
+    """2-significant-digit rounding as in the reference tests."""
+    import math
+    return round(x, -int(math.floor(math.log10(abs(x)))) + (sig - 1))
+
+
+def test_hydro_tree_structure():
+    b = _batch()
+    assert b.S == 9
+    assert b.tree.num_stages == 3
+    assert b.K == 8  # 4 nonants at stage 1 + 4 at stage 2
+    B2 = b.tree.membership(2)
+    assert B2.shape == (9, 3)
+    assert (B2.sum(axis=0) == 3).all()
+
+
+def test_hydro_ef():
+    ef = ExtensiveForm(_batch())
+    obj, x_batch = ef.solve_extensive_form()
+    assert round_pos_sig(obj) == 190.0
+    # stage-2 nonants must agree within each stage-2 node group
+    xn = x_batch[:, ef.batch.nonant_idx]
+    s2 = ef.batch.stage_slot_slices[1]
+    for g in range(3):
+        grp = xn[3 * g:3 * g + 3, s2]
+        assert np.allclose(grp, grp[0], atol=1e-9)
+
+
+def test_hydro_ph():
+    options = {"defaultPHrho": 1.0, "PHIterLimit": 100, "convthresh": 1e-6,
+               "subproblem_max_iter": 4000}
+    ph = PH(_batch(), options)
+    conv, eobj, tbound = ph.ph_main()
+    assert round_pos_sig(tbound) == 180.0
+    assert round_pos_sig(eobj) == 190.0
+    # multistage W invariant: prob-weighted W sums to zero *within each node*
+    W = np.asarray(ph.W)
+    p = np.asarray(ph.prob)
+    for t, sl in enumerate(ph.batch.stage_slot_slices):
+        B = ph.batch.tree.membership(t + 1)
+        node_sums = B.T @ (p[:, None] * W[:, sl])
+        assert np.allclose(node_sums, 0.0, atol=1e-5)

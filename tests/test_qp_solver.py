@@ -6,15 +6,14 @@ import pytest
 from scipy.optimize import linprog
 
 from mpisppy_tpu.ops.qp_solver import (
-    QPData, fold_bounds, qp_setup, qp_solve, cold_state, qp_objective)
+    QPData, fold_bounds, qp_setup, qp_solve, qp_cold_state, qp_objective)
 
 
 def _solve_batch(P, A, l, u, lb, ub, q, max_iter=20000, **kw):
     data = fold_bounds(jnp.asarray(P), jnp.asarray(A), jnp.asarray(l),
                        jnp.asarray(u), jnp.asarray(lb), jnp.asarray(ub))
-    factors = qp_setup(data)
-    S, m, n = data.A.shape
-    st = cold_state(S, n, m, dtype=data.A.dtype)
+    factors = qp_setup(data, q_ref=jnp.asarray(q))
+    st = qp_cold_state(factors)
     st, x, y = qp_solve(factors, data, jnp.asarray(q), st, max_iter=max_iter, **kw)
     return np.asarray(x), np.asarray(y), st
 
@@ -80,8 +79,8 @@ def test_warm_start_reuses_factor():
     q0 = rng.randn(S, n)
 
     data = fold_bounds(*map(jnp.asarray, (P, A, l, b, lb, ub)))
-    factors = qp_setup(data)
-    st = cold_state(S, n, data.A.shape[1], dtype=data.A.dtype)
+    factors = qp_setup(data, q_ref=jnp.asarray(q0))
+    st = qp_cold_state(factors)
     st, x0, _ = qp_solve(factors, data, jnp.asarray(q0), st, max_iter=20000)
     cold_iters = int(st.iters)
 
