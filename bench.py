@@ -1,0 +1,69 @@
+"""Benchmark: PH scenario-subproblem throughput on stochastic UC.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+What is measured: steady-state fused PH iterations (batched ADMM subproblem
+solves + nonant reductions + W update) on a UC batch (10 gens x 24 h, LP
+relaxation), scenario subproblem solves per second on one chip.
+
+Baseline derivation (see BASELINE.md): the reference's checked-in Quartz
+logs for the 10-scenario UC run (examples/uc/quartz/10scen_nofw.baseline.out)
+show ~0.8-2.5 s per PH iteration with 10 scenario subproblems solved per
+iteration by 10 Gurobi-persistent ranks (one scenario each, 2 threads per
+solve) => ~10/1.65 = 6.06 subproblem solves/sec for the whole hub cylinder.
+vs_baseline = our solves/sec on one TPU chip / 6.06.
+
+(The models are not byte-identical -- the reference's UC data lives in
+egret-format files and is solved to MIP optimality, ours is a seeded
+same-shape LP relaxation solved to 1e-4 -- so this compares subproblem
+throughput of the two execution models, which is the quantity the
+BASELINE.json metric names.)
+"""
+
+import json
+import time
+
+import jax
+
+
+def main():
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.models import uc
+
+    S = 256
+    dtype = jax.numpy.float32
+    batch = build_batch(uc.scenario_creator, uc.make_tree(S),
+                        creator_kwargs={"num_gens": 10, "num_hours": 24})
+    options = {"defaultPHrho": 100.0, "subproblem_max_iter": 400,
+               "subproblem_eps": 1e-4}
+    ph = PHBase(batch, options, dtype=dtype)
+
+    # warm-up: iter0 + one PH step (compiles both modes, factorizes)
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    ph.solve_loop(w_on=True, prox_on=True)
+    ph.W = ph.W_new
+    jax.block_until_ready(ph.x)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ph.solve_loop(w_on=True, prox_on=True)
+        ph.W = ph.W_new
+    jax.block_until_ready(ph.x)
+    dt = time.perf_counter() - t0
+
+    solves_per_sec = S * iters / dt
+    baseline = 6.06  # reference hub solves/sec, 10scen_nofw Quartz log
+    print(json.dumps({
+        "metric": "uc_ph_scenario_subproblem_solves_per_sec",
+        "value": round(solves_per_sec, 2),
+        "unit": "solves/s/chip",
+        "vs_baseline": round(solves_per_sec / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

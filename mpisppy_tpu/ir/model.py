@@ -34,6 +34,7 @@ class Var:
     """A (flat) decision-variable block of a Model."""
 
     __slots__ = ("model", "name", "size", "lb", "ub", "integer", "stage", "offset")
+    __array_ufunc__ = None  # make numpy defer to our reflected operators
 
     def __init__(self, model, name, size, lb, ub, integer, stage, offset):
         self.model = model
@@ -107,6 +108,7 @@ class AffExpr:
     """
 
     __slots__ = ("coeffs", "const", "model")
+    __array_ufunc__ = None  # make numpy defer to our reflected operators
 
     def __init__(self, coeffs, const, model):
         self.coeffs = coeffs

@@ -1,0 +1,130 @@
+"""Stochastic unit commitment — the framework's benchmark workhorse.
+
+The reference's UC example (ref. examples/uc/uc_funcs.py, uc_cylinders.py;
+paperruns/larger_uc/ up to 1000 wind scenarios) builds egret-based Pyomo
+models from data files. This is a self-contained generator of the same
+*shape* of problem — two-stage SMIP where first-stage commitment/startup
+decisions are nonanticipative and second-stage dispatch responds to a wind
+scenario — with deterministic seeded data so results are reproducible:
+
+  min  E_s[ sum_{g,t} (noload_g u_{gt} + mc_g p_{gt} + su_g st_{gt})
+            + sum_t VOLL shed_t ]
+  s.t. sum_g (Pmin_g u_{gt} + p_{gt}) + wind_t^s - spill_t + shed_t = load_t
+       p_{gt} <= (Pmax_g - Pmin_g) u_{gt}
+       st_{gt} >= u_{gt} - u_{g,t-1}          (startup definition)
+       sum_g Pmax_g u_{gt} >= load_t - wind_t^s + r*load_t   (reserve)
+       u, st in [0,1] (integer), p >= 0, shed in [0,load], spill in [0,wind]
+
+Nonants: u and st (commitment schedule), matching the reference's
+first-stage variable set.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir.model import Model
+from ..ir.tree import two_stage_tree
+
+VOLL = 5000.0          # value of lost load ($/MWh)
+RESERVE_FRAC = 0.10
+
+
+def fleet(num_gens: int, seed: int = 1234):
+    """Deterministic generator fleet: a cost-ordered mix from big cheap
+    baseload to small expensive peakers."""
+    rng = np.random.RandomState(seed)
+    frac = np.linspace(0.0, 1.0, num_gens)
+    pmax = 50.0 + 400.0 * (1.0 - frac) ** 1.5 + rng.rand(num_gens) * 20.0
+    pmin = 0.3 * pmax
+    mc = 10.0 + 70.0 * frac ** 1.2 + rng.rand(num_gens) * 5.0   # $/MWh
+    noload = 2.0 * pmax * 0.5 + rng.rand(num_gens) * 50.0        # $/h
+    startup = 30.0 * pmax + rng.rand(num_gens) * 500.0           # $/start
+    return dict(pmax=pmax, pmin=pmin, mc=mc, noload=noload, startup=startup)
+
+
+def load_profile(num_hours: int, num_gens: int):
+    """Diurnal load sized to ~70% of fleet capacity at peak."""
+    t = np.arange(num_hours)
+    shape = 0.7 + 0.25 * np.sin((t - 6) * 2 * np.pi / 24.0) \
+        + 0.05 * np.sin(t * 4 * np.pi / 24.0)
+    cap = fleet(num_gens)["pmax"].sum()
+    return 0.7 * cap * shape
+
+
+def wind_scenario(scennum: int, num_hours: int, num_gens: int):
+    """Seeded smooth wind trace, ~15% of fleet capacity on average."""
+    rng = np.random.RandomState(100000 + scennum)
+    cap = fleet(num_gens)["pmax"].sum()
+    steps = rng.randn(num_hours) * 0.25
+    level = 0.15 + 0.1 * np.cumsum(steps) / np.sqrt(np.arange(1, num_hours + 1))
+    return np.clip(level, 0.0, 0.4) * cap
+
+
+def scenario_creator(scenario_name, num_gens=10, num_hours=24,
+                     relax_integrality=True) -> Model:
+    import re
+    scennum = int(re.search(r"(\d+)$", scenario_name).group(1))
+    fl = fleet(num_gens)
+    load = load_profile(num_hours, num_gens)
+    wind = wind_scenario(scennum, num_hours, num_gens)
+    G, T = num_gens, num_hours
+    dP = fl["pmax"] - fl["pmin"]
+
+    m = Model(scenario_name, sense="min")
+    # commitment u[g,t] and startups st[g,t] flattened g-major
+    u = m.var("u", G * T, lb=0.0, ub=1.0, integer=not relax_integrality, stage=1)
+    st = m.var("st", G * T, lb=0.0, ub=1.0, integer=not relax_integrality, stage=1)
+    p = m.var("p", G * T, lb=0.0, stage=2)
+    shed = m.var("shed", T, lb=0.0, ub=load, stage=2)
+    spill = m.var("spill", T, lb=0.0, ub=np.maximum(wind, 0.0), stage=2)
+
+    gt = lambda g, t: g * T + t
+
+    # balance rows: one per hour (vectorized via coefficient matrices)
+    Bu = np.zeros((T, G * T))
+    Bp = np.zeros((T, G * T))
+    for g in range(G):
+        for t in range(T):
+            Bu[t, gt(g, t)] = fl["pmin"][g]
+            Bp[t, gt(g, t)] = 1.0
+    m.constr((Bu @ u) + (Bp @ p) - spill + shed == load - wind, name="balance")
+
+    # capacity: p - dP*u <= 0
+    Du = np.zeros((G * T, G * T))
+    for g in range(G):
+        for t in range(T):
+            Du[gt(g, t), gt(g, t)] = dP[g]
+    m.constr(p - (Du @ u) <= 0.0, name="capacity")
+
+    # startup definition: st[g,t] >= u[g,t] - u[g,t-1] (u[g,-1] = 0)
+    Su = np.zeros((G * T, G * T))
+    for g in range(G):
+        for t in range(T):
+            Su[gt(g, t), gt(g, t)] = 1.0
+            if t > 0:
+                Su[gt(g, t), gt(g, t - 1)] = -1.0
+    m.constr(st - (Su @ u) >= 0.0, name="startup_def")
+
+    # reserve: sum_g Pmax_g u_gt >= (1+r)load_t - wind_t
+    Ru = np.zeros((T, G * T))
+    for g in range(G):
+        for t in range(T):
+            Ru[t, gt(g, t)] = fl["pmax"][g]
+    m.constr((Ru @ u) >= (1.0 + RESERVE_FRAC) * load - wind, name="reserve")
+
+    cu = np.repeat(fl["noload"], T)
+    cst = np.repeat(fl["startup"], T)
+    cp = np.repeat(fl["mc"], T)
+    m.stage_cost(1, u.dot(cu) + st.dot(cst))
+    m.stage_cost(2, p.dot(cp) + shed.sum() * VOLL)
+    return m
+
+
+def make_tree(num_scens):
+    names = [f"scen{i}" for i in range(num_scens)]
+    return two_stage_tree(names, nonant_names=["u", "st"])
+
+
+def scenario_denouement(rank, scenario_name, values):
+    pass
