@@ -224,6 +224,43 @@ class PHBase(SPBase):
     def unfix_nonants(self):
         self._fixed_mask = jnp.zeros((self.batch.S, self.batch.K), bool)
 
+    # ------------- incumbent evaluation (ref. utils/xhat_tryer.py:126-182) -------------
+    @property
+    def nonant_integer_mask(self):
+        """(K,) bool: which nonant slots are integer variables."""
+        return np.asarray(self.batch.integer)[np.asarray(self.batch.nonant_idx)]
+
+    def round_nonants(self, vals):
+        """Round integer nonant slots to the nearest integer (the incumbent
+        heuristics' stand-in for MIP feasibility of first-stage vars)."""
+        vals = np.asarray(vals, dtype=np.float64)
+        mask = self.nonant_integer_mask
+        return np.where(mask, np.round(vals), vals)
+
+    def calculate_incumbent(self, xhat_vals, feas_tol=None):
+        """Fix nonants at `xhat_vals` ((K,) or (S,K)), solve with W/prox off,
+        and return the expected objective, or None if any scenario's
+        subproblem is infeasible at that x̂ (ref. xhat_tryer.py:159-182
+        calculate_incumbent, xhatbase.py:129-134 infeasibility => no bound).
+        Feasibility = primal residual of the batched solve below tolerance.
+        """
+        if feas_tol is None:
+            feas_tol = float(self.options.get("xhat_feas_tol", 1e-4))
+        self.fix_nonants(self.round_nonants(xhat_vals))
+        try:
+            self.solve_loop(w_on=False, prox_on=False, update=False)
+            pri = np.asarray(self._qp_states[False].pri_res)
+            if not np.all(pri <= feas_tol):
+                return None
+            return self.Eobjective_value()
+        finally:
+            self.unfix_nonants()
+
+    def _hub_nonants(self):
+        """(S, K) latest subproblem nonant values for cylinder traffic
+        (ref. phbase.py:562-617 nonant flat caches)."""
+        return self.nonants_of(self.x)
+
     # ------------- extension hooks (ref. extensions/extension.py:14) -------------
     def _ext(self, hook):
         if self.extensions is not None:

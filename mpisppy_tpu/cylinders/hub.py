@@ -1,0 +1,206 @@
+"""Hub: the cylinder that owns the primary algorithm and brokers bounds.
+
+Mirrors mpisppy/cylinders/hub.py:22-686: spoke classification by
+``converger_spoke_types`` (ref. hub.py:245-283), best-bound bookkeeping
+(:178-214), gap computation and rel/abs-gap termination (:72-137), the
+screen trace table (:108-121), and the terminate signal = write-id -1 to
+every spoke window (:356-368). PHHub pushes Ws + nonants and pulls bounds
+each `sync()` (ref. hub.py:417-428).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import global_toc
+from .spcommunicator import SPCommunicator, Window
+from .spoke import ConvergerSpokeType
+
+
+class Hub(SPCommunicator):
+    def __init__(self, spbase_object, spokes=None, options=None):
+        super().__init__(spbase_object, options)
+        self.spokes = list(spokes or [])
+        # best bounds for a MIN problem: outer = lower, inner = upper/incumbent
+        self.BestOuterBound = -math.inf
+        self.BestInnerBound = math.inf
+        self._spoke_last_ids = [0] * len(self.spokes)
+        self.latest_ib_char = " "
+        self.latest_ob_char = " "
+        self._print_rows = 0
+        self.extra_checks = bool((options or {}).get("extra_checks", False))
+
+    # ---- topology (ref. hub.py:245-308 + spcommunicator.py:97) ----
+    def make_windows(self):
+        self.outer_bound_spoke_indices = set()
+        self.inner_bound_spoke_indices = set()
+        self.w_spoke_indices = set()
+        self.nonant_spoke_indices = set()
+        for i, sp in enumerate(self.spokes):
+            ts = sp.converger_spoke_types
+            if ConvergerSpokeType.OUTER_BOUND in ts:
+                self.outer_bound_spoke_indices.add(i)
+            if ConvergerSpokeType.INNER_BOUND in ts:
+                self.inner_bound_spoke_indices.add(i)
+            if ConvergerSpokeType.W_GETTER in ts:
+                self.w_spoke_indices.add(i)
+            if ConvergerSpokeType.NONANT_GETTER in ts:
+                self.nonant_spoke_indices.add(i)
+            sp.hub_window = Window(sp.remote_window_length())
+            sp.my_window = Window(sp.local_window_length())
+        self.windows_made = True
+
+    # ---- bound bookkeeping (ref. hub.py:178-214) ----
+    def OuterBoundUpdate(self, new_bound, char=" "):
+        if new_bound > self.BestOuterBound:
+            self.BestOuterBound = new_bound
+            self.latest_ob_char = char
+            return True
+        return False
+
+    def InnerBoundUpdate(self, new_bound, char=" "):
+        if new_bound < self.BestInnerBound:
+            self.BestInnerBound = new_bound
+            self.latest_ib_char = char
+            return True
+        return False
+
+    def receive_bounds(self):
+        """Read every bound spoke's window; freshness via write-id
+        (ref. hub.py:333-354)."""
+        for i, sp in enumerate(self.spokes):
+            values, wid = sp.my_window.read()
+            if wid <= self._spoke_last_ids[i]:
+                continue
+            self._spoke_last_ids[i] = wid
+            if i in self.outer_bound_spoke_indices:
+                self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
+            elif i in self.inner_bound_spoke_indices:
+                self.InnerBoundUpdate(values[0], sp.converger_spoke_char)
+
+    # ---- gap + termination (ref. hub.py:72-137) ----
+    def compute_gaps(self):
+        if not (math.isfinite(self.BestInnerBound)
+                and math.isfinite(self.BestOuterBound)):
+            return math.inf, math.inf
+        abs_gap = self.BestInnerBound - self.BestOuterBound
+        nano = abs(self.BestInnerBound)
+        rel_gap = abs_gap / nano if nano > 1e-10 else math.inf
+        return abs_gap, rel_gap
+
+    def determine_termination(self) -> bool:
+        abs_gap, rel_gap = self.compute_gaps()
+        abs_opt = self.options.get("abs_gap", None)
+        rel_opt = self.options.get("rel_gap", None)
+        if abs_opt is not None and abs_gap <= abs_opt:
+            return True
+        if rel_opt is not None and rel_gap <= rel_opt:
+            return True
+        return False
+
+    def screen_trace(self, it):
+        # print a row only when a bound moved (ref. hub.py:108-121)
+        state = (self.BestOuterBound, self.BestInnerBound)
+        if getattr(self, "_last_printed", None) == state:
+            return
+        self._last_printed = state
+        if self._print_rows % 20 == 0:
+            global_toc(f"{'Iter.':>5s}  {'Best Bound':>15s}  "
+                       f"{'Best Incumbent':>15s}  {'Rel. Gap':>9s}  "
+                       f"{'Abs. Gap':>12s}")
+        abs_gap, rel_gap = self.compute_gaps()
+        rg = f"{100 * rel_gap:8.3f}%" if math.isfinite(rel_gap) else "   inf  "
+        global_toc(f"{it:5d} {self.latest_ob_char}{self.BestOuterBound:15.4f}  "
+                   f"{self.latest_ib_char}{self.BestInnerBound:14.4f}  {rg}  "
+                   f"{abs_gap:12.4f}")
+        self._print_rows += 1
+
+    def send_terminate(self):
+        """Write-id -1 into every hub-owned window (ref. hub.py:356-368)."""
+        for sp in self.spokes:
+            sp.hub_window.kill()
+
+    def hub_finalize(self):
+        self.receive_bounds()
+        abs_gap, rel_gap = self.compute_gaps()
+        global_toc(f"Final bounds: outer {self.BestOuterBound:.4f} / inner "
+                   f"{self.BestInnerBound:.4f}, rel gap "
+                   f"{100 * rel_gap:.4f}%")
+        return self.BestOuterBound, self.BestInnerBound
+
+    def main(self):
+        raise NotImplementedError
+
+
+class PHHub(Hub):
+    """PH as the hub algorithm (ref. hub.py:371-508)."""
+
+    def setup_hub(self):
+        assert self.windows_made
+
+    def send_ws(self):
+        W = np.asarray(self.opt.W, dtype=np.float64).reshape(-1)
+        for i in self.w_spoke_indices:
+            sp = self.spokes[i]
+            has_w, has_x = sp.hub_read_layout()
+            if has_x:
+                X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
+                sp.hub_window.put(np.concatenate([W, X]))
+            else:
+                sp.hub_window.put(W)
+
+    def send_nonants(self):
+        X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
+        for i in self.nonant_spoke_indices - self.w_spoke_indices:
+            self.spokes[i].hub_window.put(X)
+
+    def sync(self):
+        """Called from inside the PH iteration (ref. phbase.py:1522)."""
+        self.send_ws()
+        self.send_nonants()
+        self.receive_bounds()
+
+    def is_converged(self) -> bool:
+        # at iter 1 seed the outer bound with PH's trivial bound
+        # (ref. hub.py:433-461)
+        if self.opt._iter <= 1 and getattr(self.opt, "trivial_bound", None) is not None:
+            self.OuterBoundUpdate(self.opt.trivial_bound, "T")
+        self.screen_trace(self.opt._iter)
+        return self.determine_termination()
+
+    def main(self):
+        self.opt.ph_main(finalize=False)
+
+
+class APHHub(PHHub):
+    """APH as the hub algorithm (ref. hub.py:606-686)."""
+
+    def main(self):
+        self.opt.APH_main(finalize=False)
+
+
+class LShapedHub(Hub):
+    """L-shaped as the hub: nonants-only pushes, bound from the master
+    (ref. hub.py:511-603)."""
+
+    def setup_hub(self):
+        assert self.windows_made
+
+    def sync(self, send_nonants=True):
+        if send_nonants:
+            X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
+            for i in self.nonant_spoke_indices:
+                self.spokes[i].hub_window.put(X)
+        self.receive_bounds()
+
+    def is_converged(self) -> bool:
+        bound = getattr(self.opt, "_LShaped_bound", None)
+        if bound is not None:
+            self.OuterBoundUpdate(bound, "B")
+        self.screen_trace(self.opt._iter)
+        return self.determine_termination()
+
+    def main(self):
+        self.opt.lshaped_algorithm(finalize=False)

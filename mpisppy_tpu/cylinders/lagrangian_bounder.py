@@ -1,0 +1,75 @@
+"""Lagrangian outer-bound spokes.
+
+``LagrangianOuterBound`` (ref. mpisppy/cylinders/lagrangian_bounder.py:5-87):
+takes the hub's W, solves all subproblems with W on / prox off, and
+publishes the expected *certified dual* bound (our Ebound is built from the
+ADMM dual vectors, so an inexactly solved subproblem cannot overstate it).
+
+``LagrangerOuterBound`` (ref. mpisppy/cylinders/lagranger_bounder.py:9-95):
+takes the hub's *nonants* instead and computes its own x̄ and W locally
+(optionally with a rescaled rho) before bounding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .spoke import OuterBoundWSpoke, OuterBoundNonantSpoke
+
+
+class LagrangianOuterBound(OuterBoundWSpoke):
+    converger_spoke_char = "L"
+
+    def lagrangian_prep(self):
+        """Trivial bound before any W arrives (ref. lagrangian_bounder.py:20-52)."""
+        self.opt.solve_loop(w_on=False, prox_on=False, update=False)
+        self.update_bound(self.opt.Ebound())
+
+    def _bound_from_Ws(self, W_flat):
+        self.opt.W = jnp.asarray(W_flat, self.opt.dtype)
+        self.opt.solve_loop(w_on=True, prox_on=False, update=False)
+        return self.opt.Ebound()
+
+    def main(self):
+        self.lagrangian_prep()
+        while not self.got_kill_signal():
+            fresh, values = self.spoke_from_hub()
+            if not fresh or values is None:
+                continue
+            W, _ = self.unpack_hub(values)
+            self.update_bound(self._bound_from_Ws(W))
+
+
+class LagrangerOuterBound(OuterBoundNonantSpoke):
+    converger_spoke_char = "A"
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options)
+        # per-iteration rho rescale factors {iter: factor}
+        # (ref. lagranger_bounder.py:20-27 json rescale option)
+        self.rho_rescale = dict(self.options.get("lagranger_rho_rescale", {}))
+        self._niter = 0
+
+    def _update_weights_and_solve(self, X):
+        opt = self.opt
+        factor = self.rho_rescale.get(self._niter)
+        if factor is not None:
+            opt.rho = opt.rho * float(factor)
+            opt.invalidate_factors()
+        opt.x = jnp.asarray(np.zeros((opt.batch.S, opt.batch.n)), opt.dtype) \
+            if opt.x is None else opt.x
+        xn = jnp.asarray(X, opt.dtype)
+        opt.xbar = opt.compute_xbar(xn)
+        opt.W = opt.W + opt.rho * (xn - opt.xbar)
+        opt.solve_loop(w_on=True, prox_on=False, update=False)
+        return opt.Ebound()
+
+    def main(self):
+        while not self.got_kill_signal():
+            fresh, values = self.spoke_from_hub()
+            if not fresh or values is None:
+                continue
+            _, X = self.unpack_hub(values)
+            self.update_bound(self._update_weights_and_solve(X))
+            self._niter += 1

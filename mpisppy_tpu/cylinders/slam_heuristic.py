@@ -1,0 +1,31 @@
+"""Slam heuristics: per-variable max/min over scenarios as an incumbent.
+
+Mirrors mpisppy/cylinders/slam_heuristic.py:24-153: reshape the hub's
+nonants to (scenario x var), take the per-var MAX (SlamUp) or MIN
+(SlamDown) across all scenarios, round integers, fix everything, evaluate.
+The reference's local-then-Allreduce(MAX/MIN) two-step collapses to one
+axis reduction over the batched nonant block.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .xhat_bounders import _XhatInnerBound
+
+
+class _SlamHeuristic(_XhatInnerBound):
+    converger_spoke_char = "S"
+    mpi_op = None  # "max" | "min"
+
+    def candidates(self, X):
+        red = np.max if self.mpi_op == "max" else np.min
+        yield red(X, axis=0)
+
+
+class SlamUpHeuristic(_SlamHeuristic):
+    mpi_op = "max"
+
+
+class SlamDownHeuristic(_SlamHeuristic):
+    mpi_op = "min"

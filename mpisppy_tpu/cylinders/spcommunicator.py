@@ -1,0 +1,92 @@
+"""Communication windows + SPCommunicator base.
+
+Mirrors the reference's RMA-window discipline (ref. mpisppy/cylinders/
+spcommunicator.py:23-124): every buffer is ``length + 1`` doubles whose
+last slot is a monotonically increasing write-id; readers detect fresh
+data by comparing ids; ``-1`` is the reserved kill value
+(ref. mpisppy/cylinders/hub.py:356-368). Ownership discipline matches
+Lock/Put/Unlock: only the owner writes, remotes read under the lock.
+
+The default backend is an in-process ``threading.Lock`` + numpy buffer;
+``Window.shared(...)`` swaps in the native C++ shared-memory backend for
+multi-process cylinder layouts (same protocol, see ops/native/spwindow).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+import numpy as np
+
+
+class Window:
+    """A one-writer many-reader buffer with the write-id protocol."""
+
+    KILL = -1
+
+    def __init__(self, length: int):
+        self.length = int(length)
+        self.buf = np.zeros(self.length + 1)
+        self.lock = threading.Lock()
+
+    # -- owner side (ref. hub.py:310-331 hub_to_spoke / spoke.py:59-80) --
+    def put(self, values) -> int:
+        values = np.asarray(values, dtype=np.float64).reshape(-1)
+        assert values.shape[0] == self.length, \
+            f"window length {self.length} != payload {values.shape[0]}"
+        with self.lock:
+            self.buf[:-1] = values
+            if self.buf[-1] >= 0:
+                self.buf[-1] += 1
+            return int(self.buf[-1])
+
+    def kill(self):
+        """Write the terminate signal (write-id -1, ref. hub.py:356)."""
+        with self.lock:
+            self.buf[-1] = Window.KILL
+
+    # -- reader side (ref. hub.py:333-354 hub_from_spoke / spoke.py:82-99) --
+    def read(self):
+        """Return (values copy, write_id)."""
+        with self.lock:
+            return self.buf[:-1].copy(), int(self.buf[-1])
+
+    def read_id(self) -> int:
+        with self.lock:
+            return int(self.buf[-1])
+
+
+class SPCommunicator:
+    """Base of Hub and Spoke: owns an algorithm (`opt`) instance and the
+    window pair used to talk across the strata (ref. spcommunicator.py:23).
+
+    Window topology is the reference's star graph: for each spoke there is
+    one hub-owned window (hub writes; that spoke reads) and one spoke-owned
+    window (spoke writes; hub reads)."""
+
+    def __init__(self, spbase_object, options=None):
+        self.opt = spbase_object
+        self.options = dict(options or {})
+        # back-pointer used by engines to call sync() mid-iteration
+        # (ref. spbase.py:503-514 weakref spcomm setter)
+        self.opt.spcomm = weakref.proxy(self)
+        self.windows_made = False
+
+    # sizes the subclass must declare before make_windows()
+    def local_window_length(self) -> int:
+        """Length of the buffer THIS cylinder writes."""
+        raise NotImplementedError
+
+    def remote_window_length(self) -> int:
+        """Length of the buffer this cylinder reads."""
+        raise NotImplementedError
+
+    def finalize(self):
+        """Post-kill wrap-up; returns a cylinder-specific result."""
+        return None
+
+    def allreduce_or(self, flag: bool) -> bool:
+        """Degenerate in-process analog of allreduce(LOR)
+        (ref. spcommunicator.py:79): single shared address space."""
+        return bool(flag)

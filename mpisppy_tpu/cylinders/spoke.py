@@ -1,0 +1,138 @@
+"""Spoke base classes and the converger-spoke taxonomy.
+
+Mirrors mpisppy/cylinders/spoke.py:17-322: spokes declare what they give
+to / take from the hub via ``converger_spoke_types``; ``_BoundSpoke``
+publishes a single bound value, nonant-variants receive the hub's nonant
+vector. Kill-signal polling is rate-limited by SPOKE_SLEEP_TIME
+(ref. spoke.py:101-111).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+
+import numpy as np
+
+from . import SPOKE_SLEEP_TIME
+from .spcommunicator import SPCommunicator, Window
+
+
+class ConvergerSpokeType(enum.Enum):
+    OUTER_BOUND = 1
+    INNER_BOUND = 2
+    W_GETTER = 3
+    NONANT_GETTER = 4
+
+
+class Spoke(SPCommunicator):
+    converger_spoke_types = ()
+    converger_spoke_char = "?"
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options)
+        self.hub_window: Window | None = None   # hub writes, we read
+        self.my_window: Window | None = None    # we write, hub reads
+        self._last_hub_id = 0
+        self._last_kill_check = 0.0
+        self.bound = None
+        self._trace = []  # (time, bound) pairs (ref. spoke.py:140-153)
+
+    # -- wire protocol (ref. spoke.py:59-99) --
+    def spoke_to_hub(self, values):
+        self.my_window.put(values)
+
+    def spoke_from_hub(self):
+        """Return (fresh, values). Fresh iff the hub's write-id advanced."""
+        values, wid = self.hub_window.read()
+        if wid == Window.KILL:
+            return False, None
+        if wid > self._last_hub_id:
+            self._last_hub_id = wid
+            return True, values
+        return False, values
+
+    def got_kill_signal(self) -> bool:
+        """Rate-limited kill check (ref. spoke.py:101-111)."""
+        now = time.monotonic()
+        if now - self._last_kill_check < SPOKE_SLEEP_TIME:
+            time.sleep(SPOKE_SLEEP_TIME)
+        self._last_kill_check = time.monotonic()
+        return self.hub_window.read_id() == Window.KILL
+
+    def main(self):
+        raise NotImplementedError
+
+    def hub_read_layout(self):
+        """(has_W, has_nonants) from the declared spoke types."""
+        return (ConvergerSpokeType.W_GETTER in self.converger_spoke_types,
+                ConvergerSpokeType.NONANT_GETTER in self.converger_spoke_types)
+
+    def remote_window_length(self) -> int:
+        S, K = self.opt.batch.S, self.opt.batch.K
+        has_w, has_x = self.hub_read_layout()
+        return (S * K if has_w else 0) + (S * K if has_x else 0)
+
+    def unpack_hub(self, values):
+        """Split the hub payload into (W or None, nonants or None)."""
+        S, K = self.opt.batch.S, self.opt.batch.K
+        has_w, has_x = self.hub_read_layout()
+        off = 0
+        W = None
+        X = None
+        if has_w:
+            W = values[off:off + S * K].reshape(S, K)
+            off += S * K
+        if has_x:
+            X = values[off:off + S * K].reshape(S, K)
+        return W, X
+
+
+class _BoundSpoke(Spoke):
+    """Publishes [bound]; CSV-style (time, bound) trace kept in memory and
+    dumpable via ``write_trace`` (ref. spoke.py:135-188 trace_prefix)."""
+
+    def local_window_length(self) -> int:
+        return 1
+
+    def update_bound(self, value: float):
+        self.bound = float(value)
+        self._trace.append((time.monotonic(), self.bound))
+        self.spoke_to_hub(np.array([self.bound]))
+
+    def write_trace(self, path):
+        with open(path, "w") as f:
+            f.write("time,bound\n")
+            for t, b in self._trace:
+                f.write(f"{t},{b}\n")
+
+    def finalize(self):
+        return self.bound
+
+
+class InnerBoundSpoke(_BoundSpoke):
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,)
+    converger_spoke_char = "I"
+
+
+class OuterBoundSpoke(_BoundSpoke):
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,)
+    converger_spoke_char = "O"
+
+
+class OuterBoundWSpoke(_BoundSpoke):
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.W_GETTER)
+    converger_spoke_char = "O"
+
+
+class InnerBoundNonantSpoke(_BoundSpoke):
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+    converger_spoke_char = "I"
+
+
+class OuterBoundNonantSpoke(_BoundSpoke):
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+    converger_spoke_char = "O"

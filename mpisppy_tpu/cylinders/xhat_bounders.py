@@ -1,0 +1,102 @@
+"""x̂ (incumbent) inner-bound spokes.
+
+On fresh hub nonants these spokes pick candidate first-stage values, fix
+them (rounding integer slots), evaluate the expected objective with the
+batched solver, and publish improvements:
+
+- ``XhatLooperInnerBound`` tries the first `xhat_scen_limit` scenarios in
+  order (ref. mpisppy/cylinders/xhatlooper_bounder.py:16-97, two-stage).
+- ``XhatShuffleInnerBound`` walks a seed-42 shuffled scenario order, one
+  candidate per loop, resuming across epochs like the reference's
+  ScenarioCycler (ref. xhatshufflelooper_bounder.py:22-286).
+- ``XhatSpecificInnerBound`` tries a fixed scenario-per-node dict every
+  pass (multistage-capable, ref. xhatspecific_bounder.py:18-120).
+
+The batched evaluator makes the reference's one-at-a-time economics
+inverted: evaluating a candidate costs one batched solve, so the "looper"
+variants chiefly differ in candidate *order*, exactly as upstream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import InnerBoundNonantSpoke
+
+
+class _XhatInnerBound(InnerBoundNonantSpoke):
+    converger_spoke_char = "X"
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options)
+        self.best_xhat = None
+
+    def candidates(self, X):
+        """Yield (K,) or (S,K) candidate nonant blocks from hub nonants X."""
+        raise NotImplementedError
+
+    def try_candidates(self, X):
+        for xhat in self.candidates(X):
+            obj = self.opt.calculate_incumbent(xhat)
+            if obj is not None and (self.bound is None or obj < self.bound):
+                self.best_xhat = self.opt.round_nonants(xhat)
+                self.update_bound(obj)
+
+    def main(self):
+        while not self.got_kill_signal():
+            fresh, values = self.spoke_from_hub()
+            if not fresh or values is None:
+                continue
+            _, X = self.unpack_hub(values)
+            self.try_candidates(X)
+
+    def finalize(self):
+        """Return (bound, best_xhat) (ref. xhatshufflelooper_bounder.py:198
+        re-fixes the global best in finalize)."""
+        return self.bound, self.best_xhat
+
+
+class XhatLooperInnerBound(_XhatInnerBound):
+    def candidates(self, X):
+        limit = int(self.options.get("xhat_scen_limit", 3))
+        for s in range(min(limit, self.opt.batch.S)):
+            yield X[s]
+
+
+class XhatShuffleInnerBound(_XhatInnerBound):
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options)
+        S = self.opt.batch.S
+        rng = np.random.RandomState(self.options.get("xhat_seed", 42))
+        self._order = rng.permutation(S)        # ref. :108-111 seed 42
+        self._pos = 0                           # ScenarioCycler resume point
+
+    def candidates(self, X):
+        # one candidate per fresh-nonant pass; epoch wraps around
+        s = int(self._order[self._pos])
+        self._pos = (self._pos + 1) % len(self._order)
+        yield X[s]
+
+
+class XhatSpecificInnerBound(_XhatInnerBound):
+    """`xhat_scenario_dict` maps non-leaf stage (1-based) -> scenario index
+    whose values seed that stage's slots; scenarios inherit through the tree
+    membership, so this works for multistage (ref. xhatspecific_bounder.py)."""
+
+    def candidates(self, X):
+        spec = self.options.get("xhat_scenario_dict", {1: 0})
+        b = self.opt.batch
+        cand = np.empty((b.S, b.K))
+        for t, sl in enumerate(b.stage_slot_slices, start=1):
+            chosen = int(spec.get(t, 0))
+            B = b.tree.membership(t)                      # (S, N_t)
+            # per scenario s, copy stage-t slots from the chosen scenario of
+            # s's node; with one chosen scenario per stage, all scenarios in
+            # other nodes reuse their own node's representative: pick, per
+            # node, the lowest-index scenario if `chosen` is outside the node
+            path = b.tree.node_path[:, t - 1]
+            for node in range(B.shape[1]):
+                members = np.flatnonzero(path == node)
+                src = chosen if chosen in members else int(members[0])
+                cand[members, sl] = X[src, sl]
+        yield cand

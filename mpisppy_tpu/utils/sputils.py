@@ -1,0 +1,102 @@
+"""spin_the_wheel: the top-level multi-cylinder launcher.
+
+Mirrors mpisppy/utils/sputils.py:24-131: validate the hub/spoke dicts,
+instantiate one algorithm object per cylinder, wire the windows, run every
+cylinder concurrently, send the terminate signal when the hub's algorithm
+finishes, and finalize.
+
+Process-grid redesign: the reference factors MPI ranks into a
+strata x cylinder grid (ref. sputils.py:133-151 make_comms). Here each
+cylinder is a host thread driving batched device computation; the
+"cylinder_comm" axis (scenario parallelism) lives inside each engine as the
+sharded scenario axis of its batch, and the "strata_comm" axis is the
+window star wired by Hub.make_windows. The write-id/kill protocol is
+identical, so cylinder asynchrony semantics carry over.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import global_toc
+
+
+class WheelResult:
+    """What a finished wheel run exposes (the reference returns
+    (spcomm, opt_dict) tuples, ref. sputils.py:131)."""
+
+    def __init__(self, hub, spokes, spoke_results):
+        self.hub = hub
+        self.spokes = spokes
+        self.spoke_results = spoke_results
+        self.BestOuterBound, self.BestInnerBound = hub.hub_finalize()
+
+    @property
+    def best_inner_bound(self):
+        return self.BestInnerBound
+
+    @property
+    def best_outer_bound(self):
+        return self.BestOuterBound
+
+    def gap(self):
+        abs_gap, rel_gap = self.hub.compute_gaps()
+        return abs_gap, rel_gap
+
+    def best_xhat(self):
+        """Best incumbent nonants over all xhat-style spokes."""
+        best, best_obj = None, None
+        for sp, res in zip(self.spokes, self.spoke_results):
+            if isinstance(res, tuple) and len(res) == 2:
+                obj, xhat = res
+                if obj is not None and xhat is not None and \
+                        (best_obj is None or obj < best_obj):
+                    best, best_obj = xhat, obj
+        return best
+
+
+def _check_dict(d, keys, what):
+    for k in keys:
+        if k not in d:
+            raise RuntimeError(f"{what} must contain key '{k}' "
+                               "(ref. sputils.py:36-60 dict validation)")
+
+
+def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None):
+    """Run one hub + N spokes concurrently; returns a WheelResult.
+
+    hub_dict:   {"hub_class", "hub_kwargs", "opt_class", "opt_kwargs"}
+    spoke dict: {"spoke_class", "spoke_kwargs", "opt_class", "opt_kwargs"}
+    (the reference's dict schema, ref. sputils.py:24-60)
+    """
+    _check_dict(hub_dict, ("hub_class", "opt_class"), "hub_dict")
+    for sd in list_of_spoke_dicts:
+        _check_dict(sd, ("spoke_class", "opt_class"), "spoke dict")
+
+    hub_opt = hub_dict["opt_class"](**hub_dict.get("opt_kwargs", {}))
+    spokes = []
+    for sd in list_of_spoke_dicts:
+        opt = sd["opt_class"](**sd.get("opt_kwargs", {}))
+        spokes.append(sd["spoke_class"](
+            opt, **sd.get("spoke_kwargs", {})))
+
+    hub = hub_dict["hub_class"](hub_opt, spokes=spokes,
+                                **hub_dict.get("hub_kwargs", {}))
+    hub.make_windows()
+    hub.setup_hub()
+
+    threads = [threading.Thread(target=sp.main, name=f"spoke{i}", daemon=True)
+               for i, sp in enumerate(spokes)]
+    for t in threads:
+        t.start()
+
+    try:
+        hub.main()                      # ref. sputils.py:115 spcomm.main()
+    finally:
+        hub.send_terminate()            # ref. sputils.py:117 / hub.py:356
+    for t in threads:
+        t.join(timeout=spin_timeout or 60.0)
+        if t.is_alive():
+            global_toc(f"WARNING: {t.name} did not exit cleanly")
+    spoke_results = [sp.finalize() for sp in spokes]
+    return WheelResult(hub, spokes, spoke_results)

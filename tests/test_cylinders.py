@@ -1,0 +1,102 @@
+"""Hub/spoke cylinder runs on farmer: bounds sandwich the EF optimum.
+
+Mirrors the reference's multi-cylinder integration style (run real
+concurrent cylinders end-to-end, ref. examples/afew.py:40-55) and the
+bound invariant tests (Lagrangian outer bound <= xhat inner bound,
+ref. mpisppy/tests/test_ef_ph.py:393-414).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.core.ph import PH, PHBase
+from mpisppy_tpu.cylinders.hub import PHHub
+from mpisppy_tpu.cylinders.lagrangian_bounder import (LagrangianOuterBound,
+                                                      LagrangerOuterBound)
+from mpisppy_tpu.cylinders.xhat_bounders import (XhatLooperInnerBound,
+                                                 XhatShuffleInnerBound)
+from mpisppy_tpu.cylinders.slam_heuristic import SlamUpHeuristic
+from mpisppy_tpu.utils.sputils import spin_the_wheel
+from mpisppy_tpu.models import farmer
+
+EF_OBJ = -108390.0
+
+
+def _batch(num_scens=3):
+    return build_batch(farmer.scenario_creator, farmer.make_tree(num_scens))
+
+
+def _opts(**kw):
+    o = {"defaultPHrho": 10.0, "PHIterLimit": 25, "convthresh": -1.0,
+         "subproblem_max_iter": 4000}
+    o.update(kw)
+    return o
+
+
+def test_ph_hub_with_lagrangian_and_xhat():
+    batch = _batch()
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 2e-3}},
+        "opt_class": PH,
+        "opt_kwargs": {"batch": batch, "options": _opts(PHIterLimit=200)},
+    }
+    spoke_dicts = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": {"batch": batch, "options": _opts()}},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": PHBase,
+         "opt_kwargs": {"batch": batch, "options": _opts()}},
+    ]
+    wheel = spin_the_wheel(hub_dict, spoke_dicts)
+
+    # outer <= EF optimum <= inner (certified-bound sandwich)
+    assert wheel.best_outer_bound <= EF_OBJ + 1.0
+    assert wheel.best_inner_bound >= EF_OBJ - 1.0
+    # both spokes must actually have produced bounds
+    assert np.isfinite(wheel.best_outer_bound)
+    assert np.isfinite(wheel.best_inner_bound)
+    # the run either hits the rel_gap termination or exhausts iterations
+    # with the sandwich reasonably tight
+    abs_gap, rel_gap = wheel.gap()
+    assert rel_gap < 0.03
+    # the winning incumbent must be a real first-stage plan
+    xhat = wheel.best_xhat()
+    assert xhat is not None and xhat.shape[-1] == batch.K
+
+
+def test_more_spokes_looper_slam_lagranger():
+    batch = _batch()
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {}},
+        "opt_class": PH,
+        "opt_kwargs": {"batch": batch, "options": _opts(PHIterLimit=10)},
+    }
+    spoke_dicts = [
+        {"spoke_class": LagrangerOuterBound, "opt_class": PHBase,
+         "opt_kwargs": {"batch": batch, "options": _opts()}},
+        {"spoke_class": XhatLooperInnerBound, "opt_class": PHBase,
+         "opt_kwargs": {"batch": batch, "options": _opts()}},
+        {"spoke_class": SlamUpHeuristic, "opt_class": PHBase,
+         "opt_kwargs": {"batch": batch, "options": _opts()}},
+    ]
+    wheel = spin_the_wheel(hub_dict, spoke_dicts)
+    assert wheel.best_outer_bound <= EF_OBJ + 1.0
+    assert wheel.best_inner_bound >= EF_OBJ - 1.0
+    assert np.isfinite(wheel.best_inner_bound)
+
+
+def test_window_protocol():
+    from mpisppy_tpu.cylinders.spcommunicator import Window
+
+    w = Window(3)
+    vals, wid = w.read()
+    assert wid == 0
+    w.put([1.0, 2.0, 3.0])
+    vals, wid = w.read()
+    assert wid == 1 and list(vals) == [1.0, 2.0, 3.0]
+    w.put([4.0, 5.0, 6.0])
+    assert w.read_id() == 2
+    w.kill()
+    assert w.read_id() == Window.KILL
