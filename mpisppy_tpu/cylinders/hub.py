@@ -140,26 +140,22 @@ class PHHub(Hub):
     def setup_hub(self):
         assert self.windows_made
 
-    def send_ws(self):
+    def send_ws(self, X=None):
         W = np.asarray(self.opt.W, dtype=np.float64).reshape(-1)
         for i in self.w_spoke_indices:
             sp = self.spokes[i]
             has_w, has_x = sp.hub_read_layout()
-            if has_x:
-                X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
-                sp.hub_window.put(np.concatenate([W, X]))
-            else:
-                sp.hub_window.put(W)
+            sp.hub_window.put(np.concatenate([W, X]) if has_x else W)
 
-    def send_nonants(self):
-        X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
+    def send_nonants(self, X):
         for i in self.nonant_spoke_indices - self.w_spoke_indices:
             self.spokes[i].hub_window.put(X)
 
     def sync(self):
         """Called from inside the PH iteration (ref. phbase.py:1522)."""
-        self.send_ws()
-        self.send_nonants()
+        X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
+        self.send_ws(X)
+        self.send_nonants(X)
         self.receive_bounds()
 
     def is_converged(self) -> bool:

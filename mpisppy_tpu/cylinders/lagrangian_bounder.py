@@ -13,7 +13,6 @@ takes the hub's *nonants* instead and computes its own x̄ and W locally
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 from .spoke import OuterBoundWSpoke, OuterBoundNonantSpoke
 
@@ -57,8 +56,6 @@ class LagrangerOuterBound(OuterBoundNonantSpoke):
         if factor is not None:
             opt.rho = opt.rho * float(factor)
             opt.invalidate_factors()
-        opt.x = jnp.asarray(np.zeros((opt.batch.S, opt.batch.n)), opt.dtype) \
-            if opt.x is None else opt.x
         xn = jnp.asarray(X, opt.dtype)
         opt.xbar = opt.compute_xbar(xn)
         opt.W = opt.W + opt.rho * (xn - opt.xbar)

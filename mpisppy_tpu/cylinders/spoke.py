@@ -43,14 +43,16 @@ class Spoke(SPCommunicator):
         self.my_window.put(values)
 
     def spoke_from_hub(self):
-        """Return (fresh, values). Fresh iff the hub's write-id advanced."""
+        """Return (fresh, values). Fresh iff the hub's write-id advanced.
+        Peek the id first so stale polls don't copy the whole payload."""
+        wid = self.hub_window.read_id()
+        if wid == Window.KILL or wid <= self._last_hub_id:
+            return False, None
         values, wid = self.hub_window.read()
         if wid == Window.KILL:
             return False, None
-        if wid > self._last_hub_id:
-            self._last_hub_id = wid
-            return True, values
-        return False, values
+        self._last_hub_id = wid
+        return True, values
 
     def got_kill_signal(self) -> bool:
         """Rate-limited kill check (ref. spoke.py:101-111)."""

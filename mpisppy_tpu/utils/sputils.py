@@ -85,7 +85,16 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None):
     hub.make_windows()
     hub.setup_hub()
 
-    threads = [threading.Thread(target=sp.main, name=f"spoke{i}", daemon=True)
+    spoke_errors: list[BaseException | None] = [None] * len(spokes)
+
+    def _run_spoke(i, sp):
+        try:
+            sp.main()
+        except BaseException as e:  # surface spoke crashes to the caller
+            spoke_errors[i] = e
+
+    threads = [threading.Thread(target=_run_spoke, args=(i, sp),
+                                name=f"spoke{i}", daemon=True)
                for i, sp in enumerate(spokes)]
     for t in threads:
         t.start()
@@ -94,9 +103,17 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None):
         hub.main()                      # ref. sputils.py:115 spcomm.main()
     finally:
         hub.send_terminate()            # ref. sputils.py:117 / hub.py:356
+    stuck = []
     for t in threads:
-        t.join(timeout=spin_timeout or 60.0)
+        t.join(timeout=60.0 if spin_timeout is None else spin_timeout)
         if t.is_alive():
+            stuck.append(t.name)
             global_toc(f"WARNING: {t.name} did not exit cleanly")
-    spoke_results = [sp.finalize() for sp in spokes]
+    for i, err in enumerate(spoke_errors):
+        if err is not None:
+            raise RuntimeError(
+                f"spoke {i} ({type(spokes[i]).__name__}) crashed") from err
+    # don't race finalize() against a still-running spoke thread
+    spoke_results = [None if f"spoke{i}" in stuck else sp.finalize()
+                     for i, sp in enumerate(spokes)]
     return WheelResult(hub, spokes, spoke_results)

@@ -85,6 +85,7 @@ def test_more_spokes_looper_slam_lagranger():
     assert wheel.best_outer_bound <= EF_OBJ + 1.0
     assert wheel.best_inner_bound >= EF_OBJ - 1.0
     assert np.isfinite(wheel.best_inner_bound)
+    assert np.isfinite(wheel.best_outer_bound)
 
 
 def test_window_protocol():
