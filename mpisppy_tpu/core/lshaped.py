@@ -1,0 +1,224 @@
+"""L-shaped method (Benders decomposition) for two-stage problems.
+
+The reference (ref. mpisppy/opt/lshaped.py:22-676) builds a Pyomo master on
+rank 0 by deleting second-stage structure from scenario #1, adds per-
+scenario ``eta`` epigraph variables, and iterates: master solve → Bcast x →
+parallel cut generation from subproblem duals (pyomo.contrib.benders) →
+append cuts. Minimization is hard-wired (ref. lshaped.py:23-26); two-stage
+only.
+
+TPU redesign:
+- the master is a small dense QP over [x_first (K), eta (S)] with a
+  statically shaped rolling *cut buffer* (deactivated rows are (-inf, inf)
+  two-sided bounds), so every iteration re-runs the same jitted solve on
+  new numbers — no model rebuilding (replaces master mutation at
+  ref. lshaped.py:641-658);
+- subproblem duals come from one batched ADMM solve with the nonant
+  columns' bound rows pinned at the master's x (replacing S per-rank
+  Gurobi solves + dual extraction);
+- cuts are *certified*: ops.qp_solver.benders_cut builds an affine
+  minorant of each scenario value function from the (possibly inexact)
+  dual vector, so cut validity never depends on solve tolerance, and the
+  reported outer bound is the master's own dual objective;
+- the master x doubles as an incumbent candidate every iteration (the
+  reference gets incumbents from a separate xhat spoke).
+
+Requires relatively complete recourse (no feasibility cuts — the
+reference relies on valid eta LBs + optimality cuts the same way,
+ref. lshaped.py:379-505).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import global_toc
+from ..ops.qp_solver import QPData, benders_cut
+from .ph import PHBase
+
+
+class LShapedMethod(PHBase):
+    def __init__(self, batch, options=None, rho_setter=None, extensions=None,
+                 converger=None, dtype=None, mesh=None):
+        super().__init__(batch, options, rho_setter, extensions, converger,
+                         dtype, mesh)
+        if batch.tree.num_stages != 2:
+            raise ValueError("LShapedMethod is two-stage only "
+                             "(ref. opt/lshaped.py:439-442)")
+        opts = self.options
+        self.max_lshaped_iter = int(opts.get("max_iter", 50))
+        self.lshaped_tol = float(opts.get("tol", 1e-7))
+        self.cut_slots = int(opts.get("cuts_per_scenario", 24))
+        self.master_max_iter = int(opts.get("master_max_iter", 20000))
+        self.master_eps = float(opts.get("master_eps", 1e-9))
+        self._LShaped_bound = None
+        self._build_master_template()
+
+    # ---- master construction (ref. lshaped.py:143-309) ----
+    def _build_master_template(self):
+        b = self.batch
+        S, K = b.S, b.K
+        idx = np.asarray(b.nonant_idx)
+        t = self.dtype
+
+        # first-stage rows: support entirely inside the nonant columns,
+        # taken from scenario 0 like the reference takes scenario #1
+        # (ref. lshaped.py:143 _create_master_no_scenarios)
+        A0 = np.asarray(b.A[0])
+        nonant_set = np.zeros(b.n, bool)
+        nonant_set[idx] = True
+        support = np.abs(A0) > 1e-12
+        first_rows = np.flatnonzero(~support[:, ~nonant_set].any(axis=1)
+                                    & support.any(axis=1))
+        self._first_rows = first_rows
+        m1 = len(first_rows)
+        C = self.cut_slots
+        nM = K + S
+        mM = m1 + S * C
+
+        A = np.zeros((mM, nM))
+        l = np.full(mM, -np.inf)
+        u = np.full(mM, np.inf)
+        A[:m1, :K] = A0[np.ix_(first_rows, idx)]
+        l[:m1] = np.asarray(b.l[0])[first_rows]
+        u[:m1] = np.asarray(b.u[0])[first_rows]
+        # cut slot rows: eta_s - g'x >= const  (g, const filled per round)
+        for s in range(S):
+            A[m1 + s * C: m1 + (s + 1) * C, K + s] = 1.0
+
+        lbx = np.asarray(b.lb)[:, idx].max(axis=0)
+        ubx = np.asarray(b.ub)[:, idx].min(axis=0)
+        self._mA = np.asarray(A)
+        self._ml = l
+        self._mu = u
+        self._m1 = m1
+        self._lb_master = np.concatenate([lbx, np.full(S, -np.inf)])
+        self._ub_master = np.concatenate([ubx, np.full(S, np.inf)])
+        self._q_master = np.concatenate([np.zeros(K), np.asarray(b.prob)])
+        self._P_master = np.zeros(nM)
+        self._cut_round = 0
+
+    def set_eta_bounds(self):
+        """Valid per-scenario eta lower bounds from one *unconstrained-x1*
+        batched solve: min_x f_s(x) <= V_s(b) for every b
+        (ref. lshaped.py:335-350 set_eta_bounds Allreduce MAX)."""
+        self.unfix_nonants()
+        self.solve_loop(w_on=False, prox_on=False, update=False)
+        eta_lb = np.asarray(self._last_dual_obj)
+        eta_lb = np.where(np.isfinite(eta_lb), eta_lb,
+                          float(self.options.get("valid_eta_lb", -1e9)))
+        K = self.batch.K
+        self._lb_master[K:] = eta_lb
+
+    def add_cuts(self, const, g_nonant):
+        """Write this round's S cuts into the rolling slot buffer."""
+        S, K = self.batch.S, self.batch.K
+        C = self.cut_slots
+        slot = self._cut_round % C
+        for s in range(S):
+            r = self._m1 + s * C + slot
+            self._mA[r, :K] = -g_nonant[s]
+            self._ml[r] = const[s]
+            self._mu[r] = np.inf
+        self._cut_round += 1
+
+    def solve_master(self):
+        """Exact host-side master LP solve.
+
+        The master is a tiny (m1 + S*C rows) *sequential* LP — the opposite
+        shape of what the batched device kernel is for (tiny, degenerate,
+        cut rows nearly parallel: ADMM stalls on it). The device owns the
+        batched scenario solves; the master rides HiGHS on the host, the
+        same division of labor as the reference's rank-0 master Gurobi
+        solve (ref. lshaped.py:600-610). The returned LB is the master LP
+        optimum — a valid outer bound because every cut is a certified
+        minorant."""
+        from scipy.optimize import linprog
+
+        A, l, u = self._mA, self._ml, self._mu
+        rows_u = np.isfinite(u)
+        rows_l = np.isfinite(l)
+        A_ub = np.concatenate([A[rows_u], -A[rows_l]])
+        b_ub = np.concatenate([u[rows_u], -l[rows_l]])
+        bounds = [(lo if np.isfinite(lo) else None,
+                   hi if np.isfinite(hi) else None)
+                  for lo, hi in zip(self._lb_master, self._ub_master)]
+        res = linprog(self._q_master, A_ub=A_ub, b_ub=b_ub, bounds=bounds,
+                      method="highs")
+        if res.status != 0:
+            raise RuntimeError(f"L-shaped master solve failed: {res.message}")
+        K = self.batch.K
+        return res.x[:K], res.x[K:], float(res.fun)
+
+    def generate_cuts(self, xf):
+        """One batched subproblem solve at x1=xf -> S certified cuts +
+        incumbent value (ref. lshaped.py:639 generate_cut)."""
+        b = self.batch
+        self.fix_nonants(xf)
+        try:
+            self.solve_loop(w_on=False, prox_on=False, update=False)
+            feasible = bool(np.all(np.asarray(self._qp_states[False].pri_res)
+                                   <= float(self.options.get("xhat_feas_tol", 1e-4))))
+            ub = self.Eobjective_value() if feasible else None
+            # rebuild the pinned-bound data the step used for the duals
+            d0 = self._data_with_prox(False)
+            mA = d0.A.shape[1] - d0.P_diag.shape[1]
+            idx = self.nonant_idx
+            fixed = jnp.broadcast_to(jnp.asarray(self.round_nonants(xf), self.dtype),
+                                     (b.S, b.K))
+            bl = d0.l.at[:, mA + idx].set(fixed)
+            bu = d0.u.at[:, mA + idx].set(fixed)
+            d = QPData(d0.P_diag, d0.A, bl, bu)
+            pmask = jnp.zeros(b.n, bool).at[idx].set(True)
+            b0 = jnp.zeros((b.S, b.n), self.dtype).at[:, idx].set(fixed)
+            const, g = benders_cut(d, self.c, self.c0, self.y, mA, pmask, b0)
+            g_nonant = np.asarray(g)[:, np.asarray(b.nonant_idx)]
+            return np.asarray(const), g_nonant, ub
+        finally:
+            self.unfix_nonants()
+
+    # ---- the driver loop (ref. lshaped.py:507-676 lshaped_algorithm) ----
+    def lshaped_algorithm(self, finalize=True):
+        verbose = self.verbose
+        self.set_eta_bounds()
+        best_ub = np.inf
+        best_xf = None
+        self._iter = 0
+        for it in range(1, self.max_lshaped_iter + 1):
+            self._iter = it
+            xf, eta, lb = self.solve_master()
+            if self._LShaped_bound is None or lb > self._LShaped_bound:
+                self._LShaped_bound = lb
+            const, g_nonant, ub = self.generate_cuts(xf)
+            self._master_xf = xf
+            if ub is not None and ub < best_ub:
+                best_ub, best_xf = ub, xf.copy()
+                self.best_ub, self.best_xf = best_ub, best_xf
+            self.add_cuts(const, g_nonant)
+            gap = best_ub - self._LShaped_bound
+            if verbose:
+                global_toc(f"L-shaped iter {it}: LB={self._LShaped_bound:.4f} "
+                           f"UB={best_ub:.4f} gap={gap:.3e}")
+            if self.spcomm is not None:
+                self.spcomm.sync(send_nonants=True)
+                if self.spcomm.is_converged():
+                    break
+            # stop when the epigraph is tight: master eta matches V(x)
+            viol = np.max(const + np.sum(g_nonant * xf[None, :], axis=1) - eta)
+            if viol <= self.lshaped_tol * max(1.0, abs(best_ub)):
+                global_toc(f"L-shaped converged at iter {it}", verbose)
+                break
+        self.best_ub = best_ub
+        self.best_xf = best_xf
+        if finalize:
+            return self._LShaped_bound, best_ub, best_xf
+        return self._LShaped_bound
+
+    def _hub_nonants(self):
+        """Master x broadcast over scenarios for cylinder traffic."""
+        xf = getattr(self, "_master_xf", None)
+        if xf is None:
+            return jnp.zeros((self.batch.S, self.batch.K), self.dtype)
+        return jnp.broadcast_to(jnp.asarray(xf, self.dtype),
+                                (self.batch.S, self.batch.K))

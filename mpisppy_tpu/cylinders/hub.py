@@ -195,6 +195,11 @@ class LShapedHub(Hub):
         bound = getattr(self.opt, "_LShaped_bound", None)
         if bound is not None:
             self.OuterBoundUpdate(bound, "B")
+        # the master's x is evaluated against all subproblems every
+        # iteration, so the engine's own incumbent is a valid inner bound
+        ub = getattr(self.opt, "best_ub", None)
+        if ub is not None and math.isfinite(ub):
+            self.InnerBoundUpdate(ub, "B")
         self.screen_trace(self.opt._iter)
         return self.determine_termination()
 

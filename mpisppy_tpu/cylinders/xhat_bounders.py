@@ -37,6 +37,12 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
 
     def try_candidates(self, X):
         for xhat in self.candidates(X):
+            # skip candidates already evaluated (the hub often re-pushes
+            # near-identical nonants; a full batched solve buys nothing)
+            key = np.asarray(self.opt.round_nonants(xhat)).tobytes()
+            if key == getattr(self, "_last_key", None):
+                continue
+            self._last_key = key
             obj = self.opt.calculate_incumbent(xhat)
             if obj is not None and (self.bound is None or obj < self.bound):
                 self.best_xhat = self.opt.round_nonants(xhat)
@@ -76,6 +82,16 @@ class XhatShuffleInnerBound(_XhatInnerBound):
         s = int(self._order[self._pos])
         self._pos = (self._pos + 1) % len(self._order)
         yield X[s]
+
+
+class XhatLShapedInnerBound(_XhatInnerBound):
+    """Evaluates the L-shaped hub's master candidate x as an incumbent
+    (ref. mpisppy/cylinders/lshaped_bounder.py:15-91). The hub broadcasts
+    the same first-stage plan to every scenario row, so the candidate is
+    just row 0 of the nonant block."""
+
+    def candidates(self, X):
+        yield X[0]
 
 
 class XhatSpecificInnerBound(_XhatInnerBound):

@@ -266,6 +266,45 @@ def _boxmin(P, r, lb, ub):
     return jnp.where(P > 0, quad_val, lin_lo + lin_hi)
 
 
+def _sup_rows(l, u, y, inf_tol=1e-9):
+    """sup over the row box of y'z: u'y+ − l'y−, +inf when a positive dual
+    pushes on an infinite bound. Shared by qp_dual_objective/benders_cut."""
+    yp = jnp.maximum(y, 0.0)
+    ym = jnp.maximum(-y, 0.0)
+    u_fin = jnp.where(jnp.isfinite(u), u, 0.0)
+    l_fin = jnp.where(jnp.isfinite(l), l, 0.0)
+    return jnp.sum(u_fin * yp - l_fin * ym, axis=-1) \
+        + jnp.sum(jnp.where((jnp.isposinf(u) & (yp > inf_tol))
+                            | (jnp.isneginf(l) & (ym > inf_tol)), jnp.inf, 0.0),
+                  axis=-1)
+
+
+def _column_bound(P, q, r, y_b, lb, ub, x_witness, r_rel_tol):
+    """Per-column contribution to the dual bound: best of (a) keep the
+    bound-row dual, (b) drop it; plus the witness fallback when both are
+    -inf. Shared by qp_dual_objective/benders_cut (see the docstrings
+    there for the derivation)."""
+    tol = r_rel_tol * jnp.maximum(1.0, jnp.abs(q))
+    r_a = jnp.where(jnp.abs(r) <= tol, 0.0, r)
+    ybp = jnp.maximum(y_b, 0.0)
+    ybm = jnp.maximum(-y_b, 0.0)
+    ub_fin = jnp.where(jnp.isfinite(ub), ub, 0.0)
+    lb_fin = jnp.where(jnp.isfinite(lb), lb, 0.0)
+    sup_b = ub_fin * ybp - lb_fin * ybm \
+        + jnp.where((jnp.isposinf(ub) & (ybp > 1e-9))
+                    | (jnp.isneginf(lb) & (ybm > 1e-9)), jnp.inf, 0.0)
+    contrib_a = _boxmin(P, r_a, lb, ub) - sup_b
+    contrib_b = _boxmin(P, r - y_b, lb, ub)
+    best = jnp.maximum(contrib_a, contrib_b)
+    if x_witness is not None:
+        r_fix = jnp.where(jnp.isposinf(ub) & (r_a < 0), 0.0, r_a)
+        r_fix = jnp.where(jnp.isneginf(lb) & (r_fix > 0), 0.0, r_fix)
+        penalty = jnp.abs(r_a - r_fix) * (2.0 * jnp.abs(x_witness) + 1.0)
+        fallback = _boxmin(P, r_fix, lb, ub) - sup_b - penalty
+        best = jnp.maximum(best, jnp.where(jnp.isneginf(best), fallback, best))
+    return best
+
+
 def qp_dual_objective(data: QPData, q, c0, y, n_rows, x_witness=None,
                       r_rel_tol=1e-6):
     """Per-scenario LOWER bound on min ½x'Px + q'x + c0 s.t. l <= Ax <= u,
@@ -292,43 +331,55 @@ def qp_dual_objective(data: QPData, q, c0, y, n_rows, x_witness=None,
     The total is  -sup_c + sum_j best_j + c0  with
     sup_c = u_c'y_c+ - l_c'y_c- over constraint rows only.
     """
-    S, m, n = data.A.shape
     lb = data.l[..., n_rows:]
     ub = data.u[..., n_rows:]
-    y_c = y[..., :n_rows]
     y_b = y[..., n_rows:]
+    r = q + (data.A.swapaxes(-1, -2) @ y[..., None])[..., 0]
+    best = _column_bound(data.P_diag, q, r, y_b, lb, ub, x_witness, r_rel_tol)
+    sup_c = _sup_rows(data.l[..., :n_rows], data.u[..., :n_rows],
+                      y[..., :n_rows])
+    return jnp.sum(best, axis=-1) - sup_c + c0
+
+
+def benders_cut(data: QPData, q, c0, y, n_rows, param_mask, b0,
+                r_rel_tol=1e-6):
+    """Affine minorant of the *value function* V(b) =
+    min ½x'Px + q'x + c0 s.t. l <= Ax <= u, box bounds, with the columns in
+    `param_mask` fixed at b (their box rows carry l=u=b in `data`).
+
+    Returns (const (S,), g (S, n) zero outside param_mask) such that
+    V(b) >= const + g·b[param] for all b, up to the r_rel_tol
+    residual-zeroing convention — the L-shaped optimality cut (the
+    reference gets these from exact solver duals via
+    pyomo.contrib.benders, ref. mpisppy/opt/lshaped.py:639; here they come
+    from ADMM dual vectors, so inexact subproblem solves still yield
+    tolerance-valid cuts).
+
+    Derivation: split the dual y into constraint-row duals y_c (first
+    n_rows) and bound-row duals y_b. Dropping y_b on the parameterized
+    columns, the dual function's dependence on b is
+      sum_{j in param} [ (q + A_c'y_c)_j b_j + ½P_j b_j² ],
+    and the quadratic is linearized at b0 (valid: a convex function's
+    tangent is a global minorant). Non-parameter columns contribute the
+    same per-coordinate best-of-two boxmin terms as qp_dual_objective.
+    No x_witness fallback here: its validity box is tied to the solve at
+    b0, but a cut must minorize V at EVERY b — a -inf free column simply
+    yields an inactive (-inf) cut instead."""
+    lb = data.l[..., n_rows:]
+    ub = data.u[..., n_rows:]
+    y_b = y[..., n_rows:]
+    pm = param_mask  # (n,) bool
     P = data.P_diag
 
     r = q + (data.A.swapaxes(-1, -2) @ y[..., None])[..., 0]
-    tol = r_rel_tol * jnp.maximum(1.0, jnp.abs(q))
-    r_a = jnp.where(jnp.abs(r) <= tol, 0.0, r)
+    r_c = r - y_b  # bound rows are identity, so A_b'y_b = y_b
 
-    ybp = jnp.maximum(y_b, 0.0)
-    ybm = jnp.maximum(-y_b, 0.0)
-    ub_fin = jnp.where(jnp.isfinite(ub), ub, 0.0)
-    lb_fin = jnp.where(jnp.isfinite(lb), lb, 0.0)
-    sup_b = ub_fin * ybp - lb_fin * ybm \
-        + jnp.where((jnp.isposinf(ub) & (ybp > 1e-9))
-                    | (jnp.isneginf(lb) & (ybm > 1e-9)), jnp.inf, 0.0)
-    contrib_a = _boxmin(P, r_a, lb, ub) - sup_b
-    contrib_b = _boxmin(P, r - y_b, lb, ub)
-    best = jnp.maximum(contrib_a, contrib_b)
+    # parameterized columns: affine in b, quadratic linearized at b0
+    g = jnp.where(pm, r_c + P * b0, 0.0)
+    const_param = jnp.sum(jnp.where(pm, -0.5 * P * b0 * b0, 0.0), axis=-1)
 
-    if x_witness is not None:
-        r_fix = jnp.where(jnp.isposinf(ub) & (r_a < 0), 0.0, r_a)
-        r_fix = jnp.where(jnp.isneginf(lb) & (r_fix > 0), 0.0, r_fix)
-        penalty = jnp.abs(r_a - r_fix) * (2.0 * jnp.abs(x_witness) + 1.0)
-        fallback = _boxmin(P, r_fix, lb, ub) - sup_b - penalty
-        best = jnp.maximum(best, jnp.where(jnp.isneginf(best), fallback, best))
-
-    ycp = jnp.maximum(y_c, 0.0)
-    ycm = jnp.maximum(-y_c, 0.0)
-    uc = data.u[..., :n_rows]
-    lc = data.l[..., :n_rows]
-    uc_fin = jnp.where(jnp.isfinite(uc), uc, 0.0)
-    lc_fin = jnp.where(jnp.isfinite(lc), lc, 0.0)
-    sup_c = jnp.sum(uc_fin * ycp - lc_fin * ycm, axis=-1) \
-        + jnp.sum(jnp.where((jnp.isposinf(uc) & (ycp > 1e-9))
-                            | (jnp.isneginf(lc) & (ycm > 1e-9)), jnp.inf, 0.0),
-                  axis=-1)
-    return jnp.sum(best, axis=-1) - sup_c + c0
+    best = _column_bound(P, q, r, y_b, lb, ub, None, r_rel_tol)
+    const_free = jnp.sum(jnp.where(pm, 0.0, best), axis=-1)
+    sup_c = _sup_rows(data.l[..., :n_rows], data.u[..., :n_rows],
+                      y[..., :n_rows])
+    return const_param + const_free - sup_c + c0, g

@@ -1,0 +1,76 @@
+"""Batched simplex-constrained QP solver (FWPH's per-scenario weight QP).
+
+FWPH maintains, per scenario, a convex-combination QP over previously
+generated subproblem solutions ("columns"): the reference builds a Pyomo QP
+with weight vars `a`, x = Σ a_j x_j links, and hands it to Gurobi
+(ref. mpisppy/fwph/fwph.py:691-777 _initialize_QP_subproblems, :943-987
+_set_QP_objective). Here the x variables are eliminated (x = aᵀX with X the
+(C, n) column stack), leaving a C-dimensional QP over the probability
+simplex per scenario:
+
+    min_a  b·a + w·(aG) + (ρ/2)‖aG − x̄‖²    s.t. a ≥ 0, Σa = 1
+
+with G = X[:, nonant] (C, K), b = X c the per-column base costs. C is a
+small static pad (rolling column buffer), so the whole thing batches over
+scenarios as (S, C) / (S, C, K) tensors and solves with accelerated
+projected gradient — ~hundreds of tiny fused MXU matmuls, no host loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def project_simplex(v):
+    """Batched Euclidean projection onto the probability simplex
+    (Held et al.; sort-based, jit-friendly). v: (..., C)."""
+    C = v.shape[-1]
+    mu = jnp.sort(v, axis=-1)[..., ::-1]
+    cssv = jnp.cumsum(mu, axis=-1) - 1.0
+    rho_idx = jnp.arange(1, C + 1)
+    cond = mu - cssv / rho_idx > 0
+    k = jnp.sum(cond, axis=-1, keepdims=True)  # number of positive coords
+    tau = jnp.take_along_axis(cssv, k - 1, axis=-1) / k
+    return jnp.maximum(v - tau, 0.0)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def simplex_qp_solve(G, b, w, rho, xbar, a0, iters=300):
+    """Solve the weight QP for every scenario.
+
+    G: (S, C, K) column nonant blocks; b: (S, C) base costs; w: (S, K) dual
+    weights; rho: (S, K); xbar: (S, K) prox center; a0: (S, C) warm start.
+    Returns (a, xn) with xn = aG the QP-optimal nonant values.
+
+    FISTA with a per-scenario Lipschitz bound L = ‖G diag(ρ) Gᵀ‖_F + sum
+    of linear curvature; the objective is smooth so acceleration gives
+    1/t² decay — plenty for the SDM's Γ tolerance.
+    """
+    # gradient: ∇ = b + G(w − ρ x̄) + G diag(ρ) Gᵀ a
+    lin = b + (G @ ((w - rho * xbar)[..., None]))[..., 0]      # (S, C)
+    H = (G * rho[:, None, :]) @ G.swapaxes(1, 2)               # (S, C, C)
+    L = jnp.sqrt(jnp.sum(H * H, axis=(1, 2))) + 1e-12          # (S,)
+    step = (1.0 / L)[:, None]
+
+    def body(carry, _):
+        a, y, t = carry
+        grad = lin + (H @ y[..., None])[..., 0]
+        a_new = project_simplex(y - step * grad)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        y_new = a_new + ((t - 1.0) / t_new) * (a_new - a)
+        return (a_new, y_new, t_new), None
+
+    (a, _, _), _ = jax.lax.scan(body, (a0, a0, jnp.ones(())), None,
+                                length=iters)
+    xn = (a[:, None, :] @ G)[:, 0, :]
+    return a, xn
+
+
+def qp_objective_value(G, b, w, rho, xbar, a):
+    """φ(a) per scenario (for Γ calculations)."""
+    xn = (a[:, None, :] @ G)[:, 0, :]
+    return (jnp.sum(b * a, axis=-1) + jnp.sum(w * xn, axis=-1)
+            + 0.5 * jnp.sum(rho * (xn - xbar) ** 2, axis=-1))
