@@ -75,7 +75,6 @@ class FWPH(PHBase):
         ref. fwph.py:526 _compute_dual_bound)."""
         b = self.batch
         idx = self.nonant_idx
-        G = self.columns[:, :, idx]                      # (S, C, K)
         base = (self.columns @ self.c[:, :, None])[..., 0]  # (S, C)
         a = getattr(self, "_a", None)
         if a is None or a.shape != (b.S, self.max_columns):
@@ -89,12 +88,15 @@ class FWPH(PHBase):
             # original feasible set — shares PH's prox-off KKT factor
             saved_W = self.W
             self.W = w_t
-            self.solve_loop(w_on=True, prox_on=False, update=False)
-            self.W = saved_W
+            try:
+                self.solve_loop(w_on=True, prox_on=False, update=False)
+            finally:
+                self.W = saved_W
             x_star = self.x
             if k == 0 and first_pass_bound:
-                self._local_bound = max(self._local_bound or -jnp.inf,
-                                        self.Ebound())
+                prev = (self._local_bound if self._local_bound is not None
+                        else -jnp.inf)
+                self._local_bound = max(prev, self.Ebound())
             # Γ: linearization gap of the QP iterate vs the new vertex
             lin_t = (jnp.sum(base * a, axis=-1) + self.c0
                      + jnp.sum(w_t * xn_t, axis=-1))
@@ -150,7 +152,7 @@ class FWPH(PHBase):
         return self.conv
 
     def _hub_nonants(self):
-        xn = getattr(self, "_a", None)
-        if xn is None:
+        xn_t = getattr(self, "_xn_t", None)
+        if xn_t is None:
             return super()._hub_nonants()
-        return (self._a[:, None, :] @ self.columns[:, :, self.nonant_idx])[:, 0, :]
+        return xn_t   # simplex_qp_solve already returns a @ columns[nonants]

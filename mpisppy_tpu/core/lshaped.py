@@ -155,18 +155,23 @@ class LShapedMethod(PHBase):
         """One batched subproblem solve at x1=xf -> S certified cuts +
         incumbent value (ref. lshaped.py:639 generate_cut)."""
         b = self.batch
+        # round integer nonants ONCE and use the same point for the solve,
+        # the ub, and the cut rebuild, so the duals, the incumbent value and
+        # the cut all describe the same (integer-feasible) first stage
+        xf = self.round_nonants(xf)
         self.fix_nonants(xf)
         try:
             self.solve_loop(w_on=False, prox_on=False, update=False)
-            feasible = bool(np.all(np.asarray(self._qp_states[False].pri_res)
-                                   <= float(self.options.get("xhat_feas_tol", 1e-4))))
+            tol = float(self.options.get("xhat_feas_tol", 1e-4))
+            st = self._qp_states[False]
+            feasible = bool(np.all((np.asarray(st.pri_res) <= tol)
+                                   | (np.asarray(st.pri_rel) <= tol)))
             ub = self.Eobjective_value() if feasible else None
             # rebuild the pinned-bound data the step used for the duals
             d0 = self._data_with_prox(False)
             mA = d0.A.shape[1] - d0.P_diag.shape[1]
             idx = self.nonant_idx
-            fixed = jnp.broadcast_to(jnp.asarray(self.round_nonants(xf), self.dtype),
-                                     (b.S, b.K))
+            fixed = jnp.broadcast_to(jnp.asarray(xf, self.dtype), (b.S, b.K))
             bl = d0.l.at[:, mA + idx].set(fixed)
             bu = d0.u.at[:, mA + idx].set(fixed)
             d = QPData(d0.P_diag, d0.A, bl, bu)
@@ -206,7 +211,11 @@ class LShapedMethod(PHBase):
                     break
             # stop when the epigraph is tight: master eta matches V(x)
             viol = np.max(const + np.sum(g_nonant * xf[None, :], axis=1) - eta)
-            if viol <= self.lshaped_tol * max(1.0, abs(best_ub)):
+            # scale by the incumbent when one exists; best_ub is inf until a
+            # feasible subproblem pass, and inf*tol would stop immediately
+            scale = (max(1.0, abs(best_ub)) if np.isfinite(best_ub)
+                     else max(1.0, abs(self._LShaped_bound)))
+            if viol <= self.lshaped_tol * scale:
                 global_toc(f"L-shaped converged at iter {it}", verbose)
                 break
         self.best_ub = best_ub

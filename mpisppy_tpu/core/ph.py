@@ -186,6 +186,7 @@ class PHBase(SPBase):
         self._last_base_obj = base_obj
         self._last_solved_obj = solved_obj
         self._last_dual_obj = dual_obj
+        self._ext("post_solve")  # after-each-solve hook (ref. phbase.py:955)
         return solved_obj
 
     # ------------- reference-named primitives -------------
@@ -242,19 +243,34 @@ class PHBase(SPBase):
         and return the expected objective, or None if any scenario's
         subproblem is infeasible at that x̂ (ref. xhat_tryer.py:159-182
         calculate_incumbent, xhatbase.py:129-134 infeasibility => no bound).
-        Feasibility = primal residual of the batched solve below tolerance.
+        Feasibility = primal residual of the batched solve below tolerance,
+        absolute or relative to problem scale (the solver terminates on the
+        relative criterion, so large-coefficient models can't hit a tight
+        absolute residual).
         """
         if feas_tol is None:
             feas_tol = float(self.options.get("xhat_feas_tol", 1e-4))
+        # snapshot engine state: this can run mid-iteration (XhatClosest
+        # miditer, spokes sharing an engine) and must not clobber the
+        # subproblem solutions the hub ships / convergers read, nor wipe a
+        # Fixer's pinned slots
+        saved = (self._fixed_mask, self._fixed_vals, self.x,
+                 getattr(self, "y", None), getattr(self, "_last_base_obj", None),
+                 getattr(self, "_last_solved_obj", None),
+                 getattr(self, "_last_dual_obj", None))
         self.fix_nonants(self.round_nonants(xhat_vals))
         try:
             self.solve_loop(w_on=False, prox_on=False, update=False)
-            pri = np.asarray(self._qp_states[False].pri_res)
-            if not np.all(pri <= feas_tol):
+            st = self._qp_states[False]
+            pri = np.asarray(st.pri_res)
+            rel = np.asarray(st.pri_rel)
+            if not np.all((pri <= feas_tol) | (rel <= feas_tol)):
                 return None
             return self.Eobjective_value()
         finally:
-            self.unfix_nonants()
+            (self._fixed_mask, self._fixed_vals, self.x, self.y,
+             self._last_base_obj, self._last_solved_obj,
+             self._last_dual_obj) = saved
 
     def _hub_nonants(self):
         """(S, K) latest subproblem nonant values for cylinder traffic
@@ -272,9 +288,14 @@ class PH(PHBase):
 
     def ph_main(self, finalize=True):
         self._ext("pre_iter0")
-        # Iter 0: no W, no prox (ref. phbase.py:1364 Iter0)
-        self.solve_loop(w_on=False, prox_on=False)
-        self.Update_W()  # W was zero, so W = rho(x - xbar)
+        # Iter 0: no W, no prox (ref. phbase.py:1364 Iter0). A warm start
+        # (WXBarReader / load_state) keeps the loaded W and solves with it
+        # on — the dual bound of that pass is a valid Lagrangian bound since
+        # PH-generated W satisfies sum_s p_s W_s = 0 per node.
+        warm = getattr(self, "_warm_started", False)
+        self.solve_loop(w_on=warm, prox_on=False)
+        if not warm:
+            self.Update_W()  # W was zero, so W = rho(x - xbar)
         self.trivial_bound = self.Ebound()  # certified wait-and-see bound
         self.best_bound = self.trivial_bound
         self._iter = 0

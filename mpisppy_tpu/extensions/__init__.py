@@ -1,0 +1,27 @@
+"""Extension (plugin) protocol for algorithm engines.
+
+Mirrors the reference's Extension/PHExtension/MultiPHExtension callback
+protocol (ref. mpisppy/extensions/extension.py:14-121): engines call the
+hooks ``pre_iter0 / post_iter0 / miditer / enditer / post_everything``
+around the iteration loop (ref. phbase.py:1438,1516,1552,1604) and
+``post_solve`` after each batched solve pass (ref. phbase.py:955).
+
+Each hook receives the engine (``opt``) so extensions stay stateless with
+respect to the batch; any mutable extension state lives on the extension
+instance itself.
+"""
+
+from .extension import Extension, MultiExtension
+from .fixer import Fixer, FixerTuple
+from .mipgapper import Gapper
+from .norm_rho_updater import NormRhoUpdater
+from .xhatclosest import XhatClosest
+from .diagnoser import Diagnoser
+from .avgminmaxer import MinMaxAvg
+from .wxbar_io import WXBarWriter, WXBarReader
+
+__all__ = [
+    "Extension", "MultiExtension", "Fixer", "FixerTuple", "Gapper",
+    "NormRhoUpdater", "XhatClosest", "Diagnoser", "MinMaxAvg",
+    "WXBarWriter", "WXBarReader",
+]

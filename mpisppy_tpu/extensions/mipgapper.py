@@ -1,0 +1,37 @@
+"""Gapper: subproblem-tolerance schedule keyed by PH iteration.
+
+ref. mpisppy/extensions/mipgapper.py:11. The reference sets the MIP solver's
+``mipgap`` option per a {iteration: gap} dict. In the TPU engine the
+analogous knob is the batched ADMM solver's stopping tolerance
+(``subproblem_eps``): loose early iterations converge PH faster per second,
+tight late iterations certify bounds — the exact trade the reference's
+gap schedule expresses.
+"""
+
+from __future__ import annotations
+
+from .extension import Extension
+
+
+class Gapper(Extension):
+    """options: {"mipgapdict": {iter: tol}}. At each scheduled iteration the
+    engine's subproblem tolerance is replaced and the cached jitted steps are
+    rebuilt (the tolerance is a compile-time constant of the fused step)."""
+
+    def __init__(self, options=None):
+        super().__init__(options)
+        self.schedule = {int(k): float(v)
+                         for k, v in (self.options.get("mipgapdict") or {}).items()}
+
+    def _apply(self, opt, it):
+        if it in self.schedule:
+            opt.sub_eps = self.schedule[it]
+            opt._step_fns.clear()   # eps is baked into the jitted step
+            if opt.options.get("verbose"):
+                print(f"Gapper: subproblem_eps = {opt.sub_eps:g} at iter {it}")
+
+    def pre_iter0(self, opt):
+        self._apply(opt, 0)
+
+    def miditer(self, opt):
+        self._apply(opt, opt._iter)

@@ -1,0 +1,135 @@
+"""W / x̄ save & load: warm start and algorithm-state checkpointing.
+
+ref. mpisppy/utils/wxbarwriter.py:31, wxbarreader.py:32, wxbarutils.py:40-368.
+The reference round-trips (W, x̄) through CSV files as its only warm-start /
+checkpoint mechanism (SURVEY §5.4). The algorithm state here is a handful of
+device tensors, so the native format is a single ``.npz`` holding
+(W, xbar, xsqbar, rho, iter); a CSV mode matching the reference's
+``(scenario, slot, value)`` / ``(slot, value)`` row shapes is kept for
+interop and human inspection.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from .extension import Extension
+
+
+def save_state(opt, path):
+    """Checkpoint the PH algorithm state to ``path`` (npz)."""
+    np.savez(path, W=np.asarray(opt.W), xbar=np.asarray(opt.xbar),
+             xsqbar=np.asarray(opt.xsqbar), rho=np.asarray(opt.rho),
+             iter=np.asarray(opt._iter))
+
+
+def load_state(opt, path):
+    """Restore a checkpoint saved by ``save_state`` (shape-checked)."""
+    d = np.load(path)
+    S, K = opt.batch.S, opt.batch.K
+    for key in ("W", "xbar", "xsqbar", "rho"):
+        if d[key].shape != (S, K):
+            raise ValueError(f"{key} shape {d[key].shape} != ({S}, {K})")
+    t = opt.dtype
+    opt.W = jnp.asarray(d["W"], t)
+    opt.xbar = jnp.asarray(d["xbar"], t)
+    opt.xsqbar = jnp.asarray(d["xsqbar"], t)
+    old_rho = np.asarray(opt.rho)
+    opt.rho = jnp.asarray(d["rho"], t)
+    opt._iter = int(d["iter"])
+    if not np.allclose(old_rho, d["rho"]):
+        opt.invalidate_factors()
+
+
+def write_w_csv(opt, path):
+    """(scenario, slot, value) rows (ref. wxbarutils.py:40 w_writer)."""
+    W = np.asarray(opt.W)
+    with open(path, "w") as f:
+        f.write("scenario,slot,value\n")
+        for s, name in enumerate(opt.batch.tree.scen_names):
+            for k in range(opt.batch.K):
+                f.write(f"{name},{k},{W[s, k]:.17g}\n")
+
+
+def read_w_csv(opt, path):
+    W = np.asarray(opt.W).copy()
+    name_to_s = {n: i for i, n in enumerate(opt.batch.tree.scen_names)}
+    with open(path) as f:
+        next(f)
+        for line in f:
+            name, k, v = line.rsplit(",", 2)
+            W[name_to_s[name], int(k)] = float(v)
+    opt.W = jnp.asarray(W, opt.dtype)
+
+
+def write_xbar_csv(opt, path):
+    """(slot, value) rows from the root-stage view (ref. wxbarutils.py
+    xbar_writer — xbar is per tree node; scenario 0's row carries them all)."""
+    xbar = np.asarray(opt.xbar)
+    with open(path, "w") as f:
+        f.write("slot,value\n")
+        for k in range(opt.batch.K):
+            f.write(f"{k},{xbar[0, k]:.17g}\n")
+
+
+def read_xbar_csv(opt, path):
+    xbar = np.asarray(opt.xbar).copy()
+    with open(path) as f:
+        next(f)
+        for line in f:
+            k, v = line.split(",")
+            xbar[:, int(k)] = float(v)
+    opt.xbar = jnp.asarray(xbar, opt.dtype)
+
+
+class WXBarWriter(Extension):
+    """options: {"W_fname": path or None, "Xbar_fname": path or None,
+    "ckpt_fname": path or None, "every": int}. CSV names mirror the
+    reference's PHoptions keys (ref. wxbarwriter.py:52-66)."""
+
+    def __init__(self, options=None):
+        super().__init__(options)
+        self.w_fname = self.options.get("W_fname")
+        self.x_fname = self.options.get("Xbar_fname")
+        self.ckpt_fname = self.options.get("ckpt_fname")
+        self.every = int(self.options.get("every", 0))  # 0 = only at end
+
+    def _dump(self, opt):
+        if self.w_fname:
+            write_w_csv(opt, self.w_fname)
+        if self.x_fname:
+            write_xbar_csv(opt, self.x_fname)
+        if self.ckpt_fname:
+            save_state(opt, self.ckpt_fname)
+
+    def enditer(self, opt):
+        if self.every and opt._iter % self.every == 0:
+            self._dump(opt)
+
+    def post_everything(self, opt):
+        self._dump(opt)
+
+
+class WXBarReader(Extension):
+    """options: {"init_W_fname", "init_Xbar_fname", "init_ckpt_fname"}
+    (ref. wxbarreader.py:40-55). Loads before iter 0 so PH resumes."""
+
+    def __init__(self, options=None):
+        super().__init__(options)
+        self.w_fname = self.options.get("init_W_fname")
+        self.x_fname = self.options.get("init_Xbar_fname")
+        self.ckpt_fname = self.options.get("init_ckpt_fname")
+
+    def pre_iter0(self, opt):
+        if self.ckpt_fname and os.path.exists(self.ckpt_fname):
+            load_state(opt, self.ckpt_fname)
+            opt._warm_started = True
+            return
+        if self.w_fname and os.path.exists(self.w_fname):
+            read_w_csv(opt, self.w_fname)
+            opt._warm_started = True
+        if self.x_fname and os.path.exists(self.x_fname):
+            read_xbar_csv(opt, self.x_fname)

@@ -66,8 +66,9 @@ class QPState(NamedTuple):
     L: jax.Array        # (S, n, n) Cholesky factor of current KKT matrix
     rho_scale: jax.Array  # (S,) scalar multiplier on rho_pattern
     iters: jax.Array    # scalar total ADMM iterations in last solve
-    pri_res: jax.Array  # (S,)
-    dua_res: jax.Array  # (S,)
+    pri_res: jax.Array  # (S,) unscaled
+    dua_res: jax.Array  # (S,) unscaled
+    pri_rel: jax.Array  # (S,) pri_res / problem scale (feasibility metric)
 
 
 def fold_bounds(P_diag, A, l, u, lb, ub):
@@ -148,7 +149,8 @@ def qp_cold_state(factors: QPFactors) -> QPState:
     return QPState(x=jnp.zeros((S, n), dt), y=jnp.zeros((S, m), dt), z=z,
                    L=L, rho_scale=rho_scale, iters=jnp.zeros((), jnp.int32),
                    pri_res=jnp.full((S,), jnp.inf, dt),
-                   dua_res=jnp.full((S,), jnp.inf, dt))
+                   dua_res=jnp.full((S,), jnp.inf, dt),
+                   pri_rel=jnp.full((S,), jnp.inf, dt))
 
 
 def _chol_solve(L, b):
@@ -242,9 +244,9 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
         (state.x, state.y, state.z, state.L, state.rho_scale,
          jnp.zeros((), jnp.int32), jnp.array(False)))
 
-    pri, dua, _, _ = residuals(x, y, z)
+    pri, dua, pri_sc, _ = residuals(x, y, z)
     new_state = QPState(x=x, y=y, z=z, L=L, rho_scale=rho_scale, iters=it,
-                        pri_res=pri, dua_res=dua)
+                        pri_res=pri, dua_res=dua, pri_rel=pri / pri_sc)
     x_un = D * x
     y_un = (1.0 / cs[:, None]) * E * y  # unscale duals
     return new_state, x_un, y_un

@@ -1,0 +1,193 @@
+"""Extensions & convergers: protocol, fixer, gapper, rho updater, xhat
+closest, wxbar IO round-trip, convergers.
+
+Modeled on the reference's extension smoke tests
+(ref. mpisppy/tests/test_ef_ph.py:393-414) plus checkpoint/warm-start
+round-trips for the wxbar machinery (ref. utils/wxbarutils.py).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core.ph import PH
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.extensions import (Extension, MultiExtension, Fixer, Gapper,
+                                    NormRhoUpdater, XhatClosest, Diagnoser,
+                                    MinMaxAvg, WXBarWriter, WXBarReader)
+from mpisppy_tpu.extensions.fixer import uniform_fix_list
+from mpisppy_tpu.extensions import wxbar_io
+from mpisppy_tpu.convergers import (Converger, FractionalConverger,
+                                    NormRhoConverger)
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import farmer
+
+
+def make_ph(num_scens=3, iters=5, extensions=None, converger=None,
+            use_integer=False, **opt_overrides):
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(num_scens),
+                        creator_kwargs={"use_integer": use_integer})
+    options = {"defaultPHrho": 1.0, "PHIterLimit": iters,
+               "convthresh": 1e-7, "subproblem_max_iter": 2000,
+               "subproblem_eps": 1e-7}
+    options.update(opt_overrides)
+    return PH(batch, options, extensions=extensions, converger=converger)
+
+
+class HookRecorder(Extension):
+    def __init__(self, options=None):
+        super().__init__(options)
+        self.calls = []
+
+    def pre_iter0(self, opt):
+        self.calls.append("pre_iter0")
+
+    def post_iter0(self, opt):
+        self.calls.append("post_iter0")
+
+    def miditer(self, opt):
+        self.calls.append("miditer")
+
+    def enditer(self, opt):
+        self.calls.append("enditer")
+
+    def post_everything(self, opt):
+        self.calls.append("post_everything")
+
+    def post_solve(self, opt):
+        self.calls.append("post_solve")
+
+
+def test_extension_hook_order():
+    rec = HookRecorder()
+    ph = make_ph(iters=2, extensions=rec)
+    ph.ph_main()
+    assert rec.calls[0] == "pre_iter0"
+    assert "post_iter0" in rec.calls
+    assert rec.calls.count("miditer") >= 1
+    assert rec.calls[-1] == "post_everything"
+    # post_solve fires for iter0 and each iteration's solve
+    assert rec.calls.count("post_solve") >= 2
+    # hooks are ordered: pre_iter0 < post_iter0 < first miditer
+    assert rec.calls.index("post_iter0") < rec.calls.index("miditer")
+
+
+def test_multi_extension_composes():
+    rec1, rec2 = HookRecorder(), HookRecorder()
+    ph = make_ph(iters=1, extensions=MultiExtension([rec1, rec2]))
+    ph.ph_main()
+    assert rec1.calls == rec2.calls and len(rec1.calls) > 0
+
+
+def test_fixer_fixes_converged_nonants():
+    # farmer's nonants oscillate with variance O(1) near convergence, so a
+    # loose value tolerance is needed to see fixing in a short run
+    fixer = Fixer({"id_fix_list_fct":
+                   lambda b: uniform_fix_list(b, tol=3.0, nb=2, lb=2, ub=2,
+                                              integer_only=False),
+                   "boundtol": 1e-4})
+    ph = make_ph(iters=18, extensions=fixer, defaultPHrho=2.0)
+    ph.ph_main()
+    # farmer converges fast at rho=2; slots must have been fixed and the
+    # fixed values must be respected by the final solve
+    assert fixer.nfixed > 0
+    xn = np.asarray(ph._hub_nonants())
+    mask = fixer.fixed_mask
+    assert np.allclose(xn[mask], fixer.fixed_vals[mask], atol=1e-2)
+
+
+def test_gapper_schedule_applies():
+    g = Gapper({"mipgapdict": {0: 1e-3, 2: 1e-6}})
+    ph = make_ph(iters=3, extensions=g)
+    ph.ph_main()
+    assert ph.sub_eps == 1e-6
+
+
+def test_norm_rho_updater_runs_and_keeps_convergence():
+    upd = NormRhoUpdater({"primal_dual_mult": 0.5, "rho_update_factor": 1.5})
+    ph = make_ph(iters=8, extensions=upd)
+    conv, eobj, tbound = ph.ph_main()
+    assert len(upd.prim_hist) > 0
+    assert np.isfinite(eobj)
+    # rho stayed positive and factors usable
+    assert float(np.min(np.asarray(ph.rho))) > 0
+
+
+def test_xhatclosest_produces_valid_inner_bound():
+    xc = XhatClosest()
+    ph = make_ph(iters=5, extensions=xc)
+    ph.ph_main()
+    assert xc.best_bound is not None
+    # inner bound (feasible objective) >= EF optimum for a min problem
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(3))
+    ef = ExtensiveForm(batch, {"subproblem_max_iter": 8000,
+                               "subproblem_eps": 1e-8})
+    ef_obj, _ = ef.solve_extensive_form()
+    assert xc.best_bound >= ef_obj - 1e-2 * abs(ef_obj)
+
+
+def test_diagnoser_and_minmaxavg(tmp_path):
+    d = Diagnoser({"diagnoser_outdir": str(tmp_path)})
+    mm = MinMaxAvg({"avgminmax_name": "DevotedAcreage"})
+    ph = make_ph(iters=2, extensions=MultiExtension([d, mm]))
+    ph.ph_main()
+    out = tmp_path / "diagnoser.csv"
+    assert out.exists()
+    lines = out.read_text().strip().splitlines()
+    assert lines[0] == "iter,scenario,objective"
+    assert len(lines) > 3
+    assert len(mm.history) >= 2
+
+
+def test_wxbar_roundtrip(tmp_path):
+    ph = make_ph(iters=4)
+    ph.ph_main()
+    ck = str(tmp_path / "state.npz")
+    wf, xf = str(tmp_path / "w.csv"), str(tmp_path / "xbar.csv")
+    wxbar_io.save_state(ph, ck)
+    wxbar_io.write_w_csv(ph, wf)
+    wxbar_io.write_xbar_csv(ph, xf)
+
+    ph2 = make_ph(iters=4)
+    wxbar_io.load_state(ph2, ck)
+    assert np.allclose(np.asarray(ph2.W), np.asarray(ph.W))
+    assert np.allclose(np.asarray(ph2.xbar), np.asarray(ph.xbar))
+    assert ph2._iter == ph._iter
+
+    ph3 = make_ph(iters=4)
+    wxbar_io.read_w_csv(ph3, wf)
+    wxbar_io.read_xbar_csv(ph3, xf)
+    assert np.allclose(np.asarray(ph3.W), np.asarray(ph.W), atol=1e-12)
+    assert np.allclose(np.asarray(ph3.xbar)[0], np.asarray(ph.xbar)[0],
+                       atol=1e-12)
+
+
+def test_wxbar_extensions_warm_start(tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    ph = make_ph(iters=5, extensions=WXBarWriter({"ckpt_fname": ck}))
+    ph.ph_main()
+    cold_trivial = ph.trivial_bound
+
+    # restarting from the checkpoint keeps the trained W, so the iter-0
+    # Lagrangian bound must be tighter (greater, for min) than the cold
+    # wait-and-see bound, while staying a valid outer bound
+    ph2 = make_ph(iters=1, extensions=WXBarReader({"init_ckpt_fname": ck}))
+    ph2.ph_main()
+    assert getattr(ph2, "_warm_started", False)
+    assert ph2.trivial_bound > cold_trivial
+    assert ph2.trivial_bound <= -108390.0 + 10.0  # EF optimum + slack
+
+
+def test_fractional_converger():
+    ph = make_ph(iters=30, converger=FractionalConverger, use_integer=True,
+                 fracintsnotconv_conv_thresh=1.1)  # trivially true
+    ph.ph_main()
+    assert ph._iter <= 2   # fired on the first check
+
+
+def test_norm_rho_converger_terminates():
+    ph = make_ph(iters=50, converger=NormRhoConverger,
+                 norm_rho_converger_conv_thresh=1e3)  # loose => early stop
+    ph.ph_main()
+    assert ph._iter <= 2
+    assert isinstance(ph.converger, NormRhoConverger)
+    assert ph.converger.last_norm < 1e3
