@@ -1,2 +1,3 @@
 from .spbase import SPBase  # noqa: F401
 from .ef import ExtensiveForm  # noqa: F401
+from .aph import APH  # noqa: F401

@@ -1,0 +1,229 @@
+"""APH: Asynchronous Projective Hedging (Algorithm 2 of the APH paper).
+
+The reference (ref. mpisppy/opt/aph.py:54-921) runs APH as a two-thread
+asynchronous runtime: a listener thread doing periodic Allreduces of
+(x̄, x̄², ȳ) + (τ, φ, norms) concatenations, a side-gig computing the
+projective quantities when enough ranks have fresh data, and a worker doing
+phi-based partial dispatch of subproblem solves.
+
+The math per iteration (notation as in the reference):
+  y_s   = W_s + ρ(x_s − z_s)             (dual estimate, dispatched scens
+                                          only; y ≡ 0 at iter 1)
+  x̄,x̄²,ȳ = prob-weighted per-node means ("FirstReduce", aph.py:393-407)
+  u_s   = x_s − x̄;  v = ȳ               (side gig, aph.py:269-291)
+  τ     = Σ_s p_s (‖u_s‖² + ‖ȳ‖²/γ)     (aph.py:313-316)
+  φ     = Σ_s p_s ⟨z_s − x_s, W_s − y_s⟩ (compute_phis_summand, aph.py:190-201)
+  θ     = ν φ/τ  if τ>0 and φ>0 else 0   (Update_theta_zw, aph.py:451-462)
+  W_s  += θ u_s;   z_s += θ ȳ/γ          (z := x̄ at iter 1) (aph.py:474-486)
+  conv  = ‖u‖_p/‖W‖_p + ‖v‖_p/‖z‖_p      (Compute_Convergence, aph.py:497-523)
+  dispatch: the ⌈frac·S⌉ most-negative post-step φ_s, tie-broken by least
+  recently dispatched (APH_solve_loop, aph.py:552-669); subproblem objective
+  is f_s(x) + W·x + (ρ/2)‖x − z‖² — prox against z, not x̄ (aph.py:866-883).
+
+TPU redesign:
+- The listener/side-gig machinery exists because MPI reductions are
+  expensive and ranks drift; on a TPU mesh the reductions are the same
+  membership matmuls as PH (psum under sharding) inside one fused jitted
+  update, so "enough fresh ranks" (async_frac_needed) is always 100% and
+  the async staleness model is carried entirely by **partial dispatch**:
+  non-dispatched scenarios keep stale x (and lagged W/z when use_lag), which
+  is exactly the reference's worker-view of a straggler rank.
+- Dispatch = a boolean mask over the scenario axis. The batch solves as one
+  SIMD program; non-dispatched scenarios' solutions are simply not accepted
+  (x, y keep their old values), costing nothing extra on the MXU.
+- The subproblem shares PH's cached prox-on KKT factorization: the prox
+  center enters only the linear term q = c + scatter(W − ρz).
+
+Options (reference names accepted): APHnu, APHgamma, dispatch_frac,
+aph_use_lag; async_frac_needed / async_sleep_secs are accepted and ignored
+(no listener thread exists to tune).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import global_toc
+from .ph import PHBase
+
+
+@partial(jax.jit, static_argnames=("iter1",))
+def _aph_update(xn, W, y, z, rho, prob, xbar, ybar, nu, gamma, iter1: bool):
+    """The fused projective-hedging update: side-gig quantities + θ-step
+    + convergence + post-step φ in one XLA program (collectives under
+    sharding). xbar/ybar are the FirstReduce results (membership matmuls).
+    """
+    u = xn - xbar                                     # (S, K)
+    pusq = jnp.dot(prob, jnp.sum(u * u, axis=1))
+    pvsq = jnp.dot(prob, jnp.sum(ybar * ybar, axis=1))
+    tau = pusq + pvsq / gamma
+    phi = jnp.dot(prob, jnp.sum((z - xn) * (W - y), axis=1))
+    theta = jnp.where((tau > 0) & (phi > 0), nu * phi / jnp.maximum(tau, 1e-30),
+                      0.0)
+    W_new = W + theta * u
+    z_new = xbar if iter1 else z + theta * ybar / gamma
+    pwsq = jnp.dot(prob, jnp.sum(W_new * W_new, axis=1))
+    pzsq = jnp.dot(prob, jnp.sum(z_new * z_new, axis=1))
+    conv = jnp.where((pwsq > 0) & (pzsq > 0),
+                     jnp.sqrt(pusq) / jnp.sqrt(jnp.maximum(pwsq, 1e-30))
+                     + jnp.sqrt(pvsq) / jnp.sqrt(jnp.maximum(pzsq, 1e-30)),
+                     jnp.inf)
+    # post-step per-scenario phis drive dispatch (ref. aph.py:755 phisum)
+    phis = prob * jnp.sum((z_new - xn) * (W_new - y), axis=1)
+    return W_new, z_new, tau, phi, theta, conv, phis, pusq, pvsq, pwsq, pzsq
+
+
+class APH(PHBase):
+    """Asynchronous Projective Hedging engine (ref. mpisppy/opt/aph.py:54).
+
+    The reference's ``y`` (dual estimate) is named ``y_aph`` here because
+    PHBase.y already carries the QP constraint duals of the last solve.
+    """
+
+    def __init__(self, batch, options=None, **kw):
+        super().__init__(batch, options, **kw)
+        o = self.options
+        self.nu = float(o.get("APHnu", 1.0))
+        self.gamma = float(o.get("APHgamma", 1.0))
+        self.dispatch_frac = float(o.get("dispatch_frac", 1.0))
+        self.use_lag = bool(o.get("aph_use_lag", False))
+        S, K = self.batch.S, self.batch.K
+        t = self.dtype
+        self.z = jnp.zeros((S, K), t)
+        self.y_aph = jnp.zeros((S, K), t)
+        self.ybar = jnp.zeros((S, K), t)
+        self.phis = np.zeros(S)
+        self._last_dispatch = np.zeros(S, np.int64)
+        self._dispatched = np.ones(S, bool)   # iter 0 solves everyone
+        self.theta = 0.0
+        self.tau = self.phi = 0.0
+
+    # ---- dispatch selection (ref. aph.py:592-640 _dispatch_list) ----
+    def _dispatch_mask(self, it, frac):
+        S = self.batch.S
+        scnt = max(1, int(np.ceil(S * frac)))
+        if scnt >= S:
+            return np.ones(S, bool)
+        phis = np.asarray(self.phis)
+        mask = np.zeros(S, bool)
+        neg = np.flatnonzero(phis < 0)
+        take = neg[np.argsort(phis[neg])][:scnt]
+        mask[take] = True
+        short = scnt - take.size
+        if short > 0:
+            # least-recently-dispatched fill, phi as implicit tie-break
+            rest = np.flatnonzero(~mask)
+            oldest = rest[np.argsort(self._last_dispatch[rest],
+                                     kind="stable")][:short]
+            mask[oldest] = True
+        return mask
+
+    # ---- the solve with prox against z (ref. aph.py:866-883) ----
+    def _aph_solve(self, mask):
+        """Batched solve of min f_s + W·x + (ρ/2)‖x−z‖², accepting results
+        only for dispatched scenarios (the TPU carrier of asynchrony)."""
+        W_solve = self._W_lag if self.use_lag else self.W
+        z_solve = self._z_lag if self.use_lag else self.z
+        saved_xbar, saved_W = self.xbar, self.W
+        x_old, y_old = self.x, self.y
+        self.xbar, self.W = z_solve, W_solve   # prox center := z
+        try:
+            self.solve_loop(w_on=True, prox_on=True, update=False)
+        finally:
+            self.xbar, self.W = saved_xbar, saved_W
+        m = jnp.asarray(mask)[:, None]
+        self.x = jnp.where(m, self.x, x_old)
+        if y_old is not None:
+            self.y = jnp.where(m, self.y, y_old)
+        if self.use_lag:
+            # lag: dispatched scenarios pick up current (W, z) for their
+            # NEXT solve (ref. aph.py:671-683 _update_foropt)
+            self._W_lag = jnp.where(m, self.W, self._W_lag)
+            self._z_lag = jnp.where(m, self.z, self._z_lag)
+        self._last_dispatch[mask] = self._iter
+        self._dispatched = mask
+
+    # ---- main loop (ref. aph.py:704-815 APH_iterk, :818 APH_main) ----
+    def APH_main(self, spcomm=None, finalize=True):
+        if spcomm is not None:
+            self.spcomm = spcomm
+        spcomm = self.spcomm   # cylinder layer may have attached one already
+        self._ext("pre_iter0")
+        # Iter 0 (ref. phbase Iter0 via aph.py:889): w/prox off
+        self.solve_loop(w_on=False, prox_on=False)
+        self.Update_W()   # W = rho(x - xbar), duals for the first pass
+        self.trivial_bound = self.Ebound()
+        self.best_bound = self.trivial_bound
+        self._iter = 0
+        self._ext("post_iter0")
+        if self.converger_cls is not None:
+            self.converger = self.converger_cls(self)
+        global_toc(f"APH iter 0: trivial bound = {self.trivial_bound:.4f}",
+                   self.verbose)
+        if self.use_lag:
+            self._W_lag = self.W
+            self._z_lag = self.z
+
+        nu, gamma = self.nu, self.gamma
+        for it in range(1, self.max_iterations + 1):
+            self._iter = it
+            xn = self.nonants_of(self.x)
+            # Update_y on the previously dispatched set (ref. aph.py:157-186;
+            # y ≡ 0 at iter 1 — "iter 1 is iter 0 post-solves")
+            if it > 1:
+                W_y = self._W_lag if self.use_lag else self.W
+                z_y = self._z_lag if self.use_lag else self.z
+                y_new = W_y + self.rho * (xn - z_y)
+                self.y_aph = jnp.where(jnp.asarray(self._dispatched)[:, None],
+                                       y_new, self.y_aph)
+            # FirstReduce + projective step, fused
+            xbar = self.compute_xbar(xn)
+            xsqbar = self.compute_xbar(xn * xn)
+            ybar = self.compute_xbar(self.y_aph)
+            (self.W, self.z, tau, phi, theta, conv, phis,
+             pusq, pvsq, pwsq, pzsq) = _aph_update(
+                xn, self.W, self.y_aph, self.z, self.rho, self.prob,
+                xbar, ybar, nu, gamma, iter1=(it == 1))
+            self.xbar, self.xsqbar, self.ybar = xbar, xsqbar, ybar
+            self.tau, self.phi, self.theta = float(tau), float(phi), float(theta)
+            self.conv = float(conv)
+            self.phis = np.asarray(phis)
+
+            if self.verbose and (it % 10 == 0 or it == 1):
+                global_toc(f"APH iter {it}: conv={self.conv:.6e} "
+                           f"tau={self.tau:.3e} phi={self.phi:.3e} "
+                           f"theta={self.theta:.3e}")
+            if spcomm is not None:
+                spcomm.sync()
+                if spcomm.is_converged():
+                    global_toc(f"APH iter {it}: hub termination", self.verbose)
+                    break
+            if self.converger is not None and self.converger.is_converged():
+                global_toc(f"APH iter {it}: converger termination", self.verbose)
+                break
+            if self.conv is not None and self.conv < self.convthresh:
+                global_toc(f"APH iter {it}: conv={self.conv:.3e} < thresh",
+                           self.verbose)
+                break
+            self._ext("miditer")
+            # dispatch & solve (frac forced to 1 at iter 1 "to get a decent
+            # w for everyone", ref. aph.py:783-786)
+            frac = 1.0 if it == 1 else self.dispatch_frac
+            mask = self._dispatch_mask(it, frac)
+            self._aph_solve(mask)
+            self._ext("enditer")
+
+        if finalize:
+            return self.post_loops()
+        return self.conv, None, self.trivial_bound
+
+    def post_loops(self):
+        self._ext("post_everything")
+        return self.conv, self.Eobjective_value(), self.trivial_bound
+
+    def _hub_nonants(self):
+        return self.nonants_of(self.x)

@@ -1,0 +1,117 @@
+"""APH engine: math invariants, dispatch, and end-to-end runs on farmer.
+
+Modeled on the reference's test_aph.py (construction + short runs,
+ref. mpisppy/tests/test_aph.py:5-9 "we often just do smoke tests") but with
+stronger gates: the projective step quantities must satisfy their defining
+invariants, partial dispatch must leave non-dispatched scenarios' solutions
+untouched, and a full run must land near the EF optimum.
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core.aph import APH
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import farmer
+
+EF3 = -108390.0
+
+
+def make_aph(num_scens=3, iters=20, **opt):
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(num_scens))
+    options = {"defaultPHrho": 1.0, "PHIterLimit": iters, "convthresh": -1.0,
+               "subproblem_max_iter": 3000, "subproblem_eps": 1e-8}
+    options.update(opt)
+    return APH(batch, options)
+
+
+def test_aph_trivial_bound_is_outer():
+    aph = make_aph(iters=2)
+    conv, eobj, trivial = aph.APH_main()
+    assert trivial <= EF3 + 1.0
+    assert np.isfinite(conv)
+
+
+def test_aph_step_invariants():
+    aph = make_aph(iters=8, APHnu=1.0, APHgamma=1.0)
+    aph.APH_main(finalize=False)
+    # tau = E[||u||^2] + E[||ybar||^2]/gamma >= 0 by construction
+    assert aph.tau >= 0
+    # theta nonzero only when phi > 0 (separating hyperplane found)
+    if aph.theta != 0:
+        assert aph.phi > 0
+    # z converged toward the nonanticipative subspace: z rows equal within
+    # each stage-1 node (all scenarios share the root for 2-stage)
+    z = np.asarray(aph.z)
+    assert np.allclose(z, z[0][None, :], atol=1e-8)
+
+
+def test_aph_converges_near_ef():
+    aph = make_aph(iters=60, defaultPHrho=10.0)
+    conv, eobj, trivial = aph.APH_main()
+    # xbar settles near the EF first-stage optimum: evaluating it as an
+    # incumbent must be feasible and within 1% of the EF objective
+    val = aph.calculate_incumbent(np.asarray(aph.xbar)[0])
+    assert val is not None
+    assert abs(val - EF3) / abs(EF3) < 0.01
+    assert trivial <= EF3 + 1.0
+
+
+def test_aph_partial_dispatch_preserves_undispatched():
+    aph = make_aph(iters=1)
+    aph.APH_main(finalize=False)          # iter 1 dispatches everyone
+    x_before = np.asarray(aph.x).copy()
+    aph._iter = 2
+    xn = aph.nonants_of(aph.x)
+    aph.phis = np.array([-1.0, 5.0, 5.0])  # only scenario 0 is negative
+    mask = aph._dispatch_mask(2, 1.0 / 3.0)
+    assert mask.tolist() == [True, False, False]
+    aph._aph_solve(mask)
+    x_after = np.asarray(aph.x)
+    # non-dispatched scenarios' solutions unchanged (stale by design)
+    assert np.array_equal(x_after[1], x_before[1])
+    assert np.array_equal(x_after[2], x_before[2])
+    assert aph._last_dispatch.tolist() == [2, 1, 1]
+
+
+def test_aph_dispatch_tiebreak_least_recent():
+    aph = make_aph(num_scens=6, iters=1)
+    aph.APH_main(finalize=False)
+    aph.phis = np.zeros(6)                 # nobody negative
+    aph._last_dispatch = np.array([3, 1, 2, 5, 4, 1])
+    mask = aph._dispatch_mask(6, 0.5)      # scnt = 3
+    # oldest dispatches win: scens 1 and 5 (iter 1), then 2 (iter 2)
+    assert mask.tolist() == [False, True, True, False, False, True]
+
+
+def test_aph_use_lag_runs():
+    aph = make_aph(iters=10, aph_use_lag=True, dispatch_frac=0.5,
+                   defaultPHrho=5.0)
+    conv, eobj, trivial = aph.APH_main()
+    assert np.isfinite(conv)
+    assert trivial <= EF3 + 1.0
+
+
+def test_aph_with_hub_spokes():
+    """APH as hub with Lagrangian + xhat spokes: the full cylinder wheel."""
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.cylinders.hub import APHHub
+    from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
+    from mpisppy_tpu.cylinders.xhat_bounders import XhatShuffleInnerBound
+    from mpisppy_tpu.utils.sputils import spin_the_wheel
+
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(3))
+    o = {"defaultPHrho": 10.0, "PHIterLimit": 40, "convthresh": -1.0,
+         "subproblem_max_iter": 3000}
+    wheel = spin_the_wheel(
+        {"hub_class": APHHub, "hub_kwargs": {"options": {"rel_gap": 5e-3}},
+         "opt_class": APH, "opt_kwargs": {"batch": batch, "options": o}},
+        [{"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+          "opt_kwargs": {"batch": batch, "options": dict(o)}},
+         {"spoke_class": XhatShuffleInnerBound, "opt_class": PHBase,
+          "opt_kwargs": {"batch": batch, "options": dict(o)}}])
+    assert wheel.best_outer_bound <= EF3 + 1.0
+    assert wheel.best_inner_bound >= EF3 - 1.0
+    assert np.isfinite(wheel.best_outer_bound)
+    assert np.isfinite(wheel.best_inner_bound)
