@@ -1,0 +1,8 @@
+"""Model families (the reference's examples corpus, ref. SURVEY §2.6).
+
+Each module provides ``scenario_creator(name, **kwargs) -> Model``,
+``make_tree(num_scens, ...) -> ScenarioTree`` and
+``scenario_denouement`` mirroring the reference's per-example contract.
+"""
+
+from . import farmer, hydro, uc, sizes, sslp, netdes, battery  # noqa: F401

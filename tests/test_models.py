@@ -1,0 +1,160 @@
+"""Model families: structure checks + EF objective cross-validation.
+
+Each model's EF (LP relaxation) is solved twice: by our batched ADMM
+ExtensiveForm engine and independently by scipy's HiGHS on an explicitly
+assembled EF LP. Matching objectives validate the whole lowering chain
+(DSL -> standard form -> batch -> EF merge) per model family. Mirrors the
+reference's sig-digit EF assertions (ref. mpisppy/tests/test_ef_ph.py:66,149).
+"""
+
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+from scipy.sparse import lil_matrix
+
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import sizes, sslp, netdes, battery
+
+
+def ef_linprog(batch):
+    """Independent EF LP: S copies of (c, A, l<=Ax<=u, lb<=x<=ub) with
+    nonant columns tied to scenario 0 by equality rows; prob-weighted
+    objective. Solved by HiGHS."""
+    S, n, m, K = batch.S, batch.n, batch.m, batch.K
+    idx = np.asarray(batch.nonant_idx)
+    N = S * n
+    cost = (batch.prob[:, None] * batch.c).reshape(-1)
+
+    A_ub_blocks, b_ub = [], []
+    A_eq_blocks, b_eq = [], []
+    for s in range(S):
+        A, l, u = batch.A[s], batch.l[s], batch.u[s]
+        eq = np.isfinite(l) & np.isfinite(u) & (l == u)
+        ub_rows = np.isfinite(u) & ~eq
+        lb_rows = np.isfinite(l) & ~eq
+        for rows, sign, rhs in ((ub_rows, 1.0, u), (lb_rows, -1.0, -l)):
+            if rows.any():
+                blk = lil_matrix((rows.sum(), N))
+                blk[:, s * n:(s + 1) * n] = sign * A[rows]
+                A_ub_blocks.append(blk)
+                b_ub.append(rhs[rows])
+        if eq.any():
+            blk = lil_matrix((eq.sum(), N))
+            blk[:, s * n:(s + 1) * n] = A[eq]
+            A_eq_blocks.append(blk)
+            b_eq.append(l[eq])
+        if s > 0:   # nonanticipativity: x_s[k] == x_0[k]
+            blk = lil_matrix((K, N))
+            for kk, col in enumerate(idx):
+                blk[kk, s * n + col] = 1.0
+                blk[kk, col] = -1.0
+            A_eq_blocks.append(blk)
+            b_eq.append(np.zeros(K))
+
+    from scipy.sparse import vstack
+    bounds = []
+    for s in range(S):
+        for j in range(n):
+            lo, hi = batch.lb[s, j], batch.ub[s, j]
+            bounds.append((None if not np.isfinite(lo) else lo,
+                           None if not np.isfinite(hi) else hi))
+    res = linprog(cost,
+                  A_ub=vstack(A_ub_blocks).tocsr() if A_ub_blocks else None,
+                  b_ub=np.concatenate(b_ub) if b_ub else None,
+                  A_eq=vstack(A_eq_blocks).tocsr() if A_eq_blocks else None,
+                  b_eq=np.concatenate(b_eq) if b_eq else None,
+                  bounds=bounds, method="highs")
+    assert res.status == 0, res.message
+    return res.fun + float(batch.prob @ batch.c0)
+
+
+CASES = [
+    ("sizes", lambda: build_batch(sizes.scenario_creator, sizes.make_tree(3),
+                                  creator_kwargs={"scenario_count": 3})),
+    ("sslp", lambda: build_batch(sslp.scenario_creator, sslp.make_tree(4),
+                                 creator_kwargs={"num_servers": 3,
+                                                 "num_clients": 8})),
+    ("netdes", lambda: build_batch(netdes.scenario_creator,
+                                   netdes.make_tree(4),
+                                   creator_kwargs={"num_nodes": 5})),
+    ("battery", lambda: build_batch(battery.scenario_creator,
+                                    battery.make_tree(3),
+                                    creator_kwargs={"T": 12})),
+]
+
+
+@pytest.mark.parametrize("name,mk", CASES, ids=[c[0] for c in CASES])
+def test_ef_matches_scipy(name, mk):
+    batch = mk()
+    want = ef_linprog(batch)
+    ef = ExtensiveForm(batch, {"subproblem_max_iter": 60000,
+                               "subproblem_eps": 1e-9})
+    got, _ = ef.solve_extensive_form()
+    assert got == pytest.approx(want, rel=2e-3, abs=2e-2), \
+        f"{name}: ADMM EF {got} vs HiGHS {want}"
+
+
+@pytest.mark.parametrize("name,mk", CASES, ids=[c[0] for c in CASES])
+def test_ph_bound_sandwich(name, mk):
+    batch = mk()
+    ef_obj = ef_linprog(batch)
+    ph = PH(batch, {"defaultPHrho": 5.0, "PHIterLimit": 30,
+                    "convthresh": 1e-6, "subproblem_max_iter": 4000})
+    conv, eobj, trivial = ph.ph_main()
+    # trivial (wait-and-see) bound is a certified outer bound on the EF-LP
+    assert trivial <= ef_obj + 1e-2 * max(1.0, abs(ef_obj))
+
+
+def test_sizes_structure_and_rho_setter():
+    batch = build_batch(sizes.scenario_creator, sizes.make_tree(3),
+                        creator_kwargs={"scenario_count": 3})
+    # nonants: 10 produced + 55 cut pairs
+    assert batch.K == 10 + 55
+    rho = sizes._rho_setter(batch)
+    assert rho.shape == (65,)
+    assert np.all(rho > 0)
+    spec = sizes.id_fix_list_fct(batch)
+    assert spec["nb"].shape == (65,)
+    # scenario demand multipliers: 0.7 / 1.0 / 1.3 of first-stage demands
+    assert sizes.demand_multiplier(0, 3) == 0.7
+    assert sizes.demand_multiplier(2, 3) == 1.3
+    assert len(set(sizes.demand_multiplier(i, 10) for i in range(10))) == 10
+
+
+def test_sizes_10_scenarios_builds():
+    batch = build_batch(sizes.scenario_creator, sizes.make_tree(10),
+                        creator_kwargs={"scenario_count": 10})
+    assert batch.S == 10
+    assert abs(batch.prob.sum() - 1.0) < 1e-9
+
+
+def test_sslp_feasibility_invariant():
+    """Each present client is assigned; capacity respected at the EF opt."""
+    batch = build_batch(sslp.scenario_creator, sslp.make_tree(4),
+                        creator_kwargs={"num_servers": 3, "num_clients": 8})
+    ef = ExtensiveForm(batch, {"subproblem_max_iter": 60000,
+                               "subproblem_eps": 1e-9})
+    _, x_batch = ef.solve_extensive_form()
+    vals = {name: np.asarray(x_batch)[:, sl]
+            for name, sl in batch.template.var_slices.items()}
+    for s in range(4):
+        h = sslp.client_presence(s, 8)
+        assign = vals["Assign"][s].reshape(3, 8)
+        assert np.allclose(assign.sum(axis=0), h, atol=1e-4)
+
+
+def test_battery_flow_balance_at_opt():
+    batch = build_batch(battery.scenario_creator, battery.make_tree(3),
+                        creator_kwargs={"T": 12})
+    ef = ExtensiveForm(batch, {"subproblem_max_iter": 60000,
+                               "subproblem_eps": 1e-9})
+    _, x_batch = ef.solve_extensive_form()
+    vals = {name: np.asarray(x_batch)[:, sl]
+            for name, sl in batch.template.var_slices.items()}
+    eff = battery.DEFAULTS["eff"]
+    for s in range(3):
+        x, p, q = vals["StateOfCharge"][s], vals["Charge"][s], vals["Discharge"][s]
+        resid = x[1:] - x[:-1] - eff * p[:-1] + q[:-1] / eff
+        assert np.max(np.abs(resid)) < 1e-3
