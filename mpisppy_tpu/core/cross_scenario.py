@@ -1,0 +1,195 @@
+"""Cross-scenario cuts: the hub-side engine support.
+
+Mirrors the reference's CrossScenarioExtension + CrossScenarioHub pair
+(ref. mpisppy/extensions/cross_scen_extension.py:16-283,
+mpisppy/cylinders/cross_scen_hub.py:11-159): every PH subproblem is
+augmented with per-scenario ``eta`` epigraph variables and an alternate
+"EF objective" (own scenario exact + probability-weighted etas for the
+others); a cut spoke ships Benders rows ``eta_s >= const_s + g_s·x`` which
+are installed as constraints on every subproblem; pacing logic occasionally
+solves the EF objective to harvest a certified outer bound ('C' rows in the
+hub trace).
+
+TPU redesign: instead of mutating Pyomo expressions per scenario, the
+scenario *batch* is augmented once up front — S eta columns (zero objective
+during normal PH solves; own eta pinned to 0) and ``max_cut_rounds × S``
+pre-allocated cut rows (placeholder ``eta_s ∈ (-inf, inf)`` rows so the
+Ruiz equilibration never sees a zero row). Installing a round of cuts
+rewrites those rows and refactorizes the batched KKT once — the analog of
+the persistent-solver constraint adds (ref. cross_scen_hub.py:73-160).
+The EF-bound solve reuses the prox-off factorization with a different
+linear term and takes the certified ADMM *dual* objective per subproblem;
+the max over subproblems is the published outer bound
+(ref. cross_scen_extension.py:71-117 _check_bound's MAX Allreduce).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ir.batch import ScenarioBatch
+from ..ops.qp_solver import (QPData, fold_bounds, qp_setup, qp_solve,
+                             qp_cold_state, qp_dual_objective)
+from .ph import PH
+
+
+def augment_batch_for_cross_cuts(batch: ScenarioBatch, max_cut_rounds=8,
+                                 eta_lb=-1e7) -> ScenarioBatch:
+    """Append S eta columns and max_cut_rounds·S placeholder cut rows.
+
+    eta columns: objective 0 (PH mode ignores them), bounds [eta_lb, inf)
+    except scenario k's own eta which is pinned to 0 (its scenario is
+    represented exactly, ref. cross_scen_extension.py:214-218 "add the
+    other etas"). Placeholder cut row (r, s) reads ``eta_s ∈ (-inf, inf)``
+    until a real cut replaces it.
+    """
+    S, n, m = batch.S, batch.n, batch.m
+    R = int(max_cut_rounds)
+    n2, m2 = n + S, m + R * S
+
+    pad_cols = lambda M: np.concatenate(
+        [M, np.zeros(M.shape[:-1] + (S,), M.dtype)], axis=-1)
+    c = pad_cols(batch.c)
+    P_diag = pad_cols(batch.P_diag)
+    c_stage = pad_cols(batch.c_stage)
+
+    A = np.zeros((S, m2, n2))
+    A[:, :m, :n] = batch.A
+    for r in range(R):
+        for s in range(S):
+            A[:, m + r * S + s, n + s] = 1.0
+    l = np.concatenate([batch.l, np.full((S, R * S), -np.inf)], axis=1)
+    u = np.concatenate([batch.u, np.full((S, R * S), np.inf)], axis=1)
+
+    lb = np.concatenate([batch.lb, np.full((S, S), float(eta_lb))], axis=1)
+    ub = np.concatenate([batch.ub, np.full((S, S), np.inf)], axis=1)
+    for k in range(S):
+        lb[k, n + k] = 0.0
+        ub[k, n + k] = 0.0
+
+    return ScenarioBatch(
+        tree=batch.tree, template=batch.template,
+        c=c, c0=batch.c0.copy(), P_diag=P_diag, A=A, l=l, u=u, lb=lb, ub=ub,
+        c_stage=c_stage, c0_stage=batch.c0_stage.copy(),
+        prob=batch.prob.copy(), nonant_idx=batch.nonant_idx.copy(),
+        nonant_stage=batch.nonant_stage.copy(),
+        stage_slot_slices=list(batch.stage_slot_slices),
+    )
+
+
+class CrossScenarioPH(PH):
+    """PH with cross-scenario cut support (two-stage only, like the
+    reference, ref. cross_scen_extension.py:120-122)."""
+
+    def __init__(self, batch, options=None, **kw):
+        options = dict(options or {})
+        cso = options.get("cross_scen_options", {})
+        self._n_orig = batch.n
+        self._m_orig = batch.m
+        self.max_cut_rounds = int(cso.get("max_cut_rounds", 8))
+        if batch.tree.num_stages != 2:
+            raise ValueError("cross-scenario cuts are two-stage only")
+        batch = augment_batch_for_cross_cuts(
+            batch, self.max_cut_rounds, float(cso.get("eta_lb", -1e7)))
+        super().__init__(batch, options, **kw)
+        self._cut_round = 0
+        self.new_cuts = False
+        self.any_cuts = False
+        # EF-mode linear term: stage-1 coefs unscaled + p_k * later-stage
+        # coefs + p_s on the eta columns (own eta pinned to 0 anyway)
+        b = self.batch
+        S, n = b.S, self._n_orig
+        c1 = np.asarray(b.c_stage)[:, 0, :]
+        c_ef = c1 + np.asarray(b.prob)[:, None] * (np.asarray(b.c) - c1)
+        c_ef[:, n:] = np.asarray(b.prob)[None, :]
+        c_ef[np.arange(S), n + np.arange(S)] = 0.0
+        self._q_ef = jnp.asarray(c_ef, self.dtype)
+        c01 = np.asarray(b.c0_stage)[:, 0]
+        self._c0_ef = jnp.asarray(
+            c01 + np.asarray(b.prob) * (np.asarray(b.c0) - c01), self.dtype)
+
+    # ---- cut installation (ref. cross_scen_hub.py:73-160) ----
+    def add_cuts(self, const, g_nonant):
+        """Install one round of S cuts ``eta_s >= const_s + g_s·x`` on every
+        subproblem; rolls over the oldest round when the buffer is full."""
+        b = self.batch
+        S, n = b.S, self._n_orig
+        idx = np.asarray(b.nonant_idx)
+        r = self._cut_round % self.max_cut_rounds
+        A = np.asarray(b.A)
+        l, u = np.asarray(b.l), np.asarray(b.u)
+        for s in range(S):
+            row = self._m_orig + r * S + s
+            A[:, row, :] = 0.0
+            A[:, row, n + s] = 1.0
+            A[:, row, idx] = -np.asarray(g_nonant[s])
+            l[:, row] = float(const[s])
+            u[:, row] = np.inf
+            # subproblem s represents scenario s exactly and its own eta is
+            # pinned to 0: its own cut row must stay a no-op placeholder,
+            # else it would constrain x directly (ref. cross_scen_extension
+            # attaches etas only for the OTHER scenarios, :214-218)
+            A[s, row, :] = 0.0
+            A[s, row, n + s] = 1.0
+            l[s, row] = -np.inf
+        b.A, b.l, b.u = A, l, u
+        self._cut_round += 1
+        self.any_cuts = True
+        self.new_cuts = True
+        # refactorize: rebuild folded data and drop every per-mode cache
+        t = self.dtype
+        self.qp_data = fold_bounds(self.P_diag, jnp.asarray(A, t),
+                                   jnp.asarray(l, t), jnp.asarray(u, t),
+                                   jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
+        self._factors.clear()
+        self._qp_states.clear()
+        self._step_fns.clear()
+
+    def update_eta_bounds(self):
+        """Tighten the eta lower bounds to the per-scenario wait-and-see
+        dual bounds of the latest prox/W-off solve (valid: V_s(x) >=
+        min_x f_s for all x; the analog of the reference's valid_eta_bound
+        option and LShaped.set_eta_bounds, ref. lshaped.py:335-350). Tight
+        eta boxes keep the certified dual objective of solve_ef_bound from
+        leaking slack through the eta columns."""
+        # the bounds must come from a prox/W-off pass (only those dual
+        # objectives are certified); run one rather than trusting whatever
+        # solve happened last
+        self.solve_loop(w_on=False, prox_on=False, update=False)
+        dual = np.asarray(self._last_dual_obj)
+        b = self.batch
+        n, S = self._n_orig, b.S
+        lb = np.asarray(b.lb)
+        lb[:, n:] = np.where(np.isfinite(dual), dual, lb[0, n:])[None, :]
+        lb[np.arange(S), n + np.arange(S)] = 0.0
+        b.lb = lb
+        t = self.dtype
+        self.qp_data = fold_bounds(self.P_diag, jnp.asarray(b.A, t),
+                                   jnp.asarray(b.l, t), jnp.asarray(b.u, t),
+                                   jnp.asarray(lb, t), jnp.asarray(b.ub, t))
+        self._factors.clear()
+        self._qp_states.clear()
+        self._step_fns.clear()
+
+    # ---- EF-bound solve (ref. cross_scen_extension.py:71-117) ----
+    def solve_ef_bound(self):
+        """Solve every subproblem under the EF objective (own scenario exact
+        + eta epigraphs for the rest); each certified dual objective lower-
+        bounds the EF optimum, and the MAX over subproblems is returned."""
+        factors = self._get_factors(False)
+        st = qp_cold_state(factors)
+        prev = self._qp_states.get(False)
+        if prev is not None:
+            st = st._replace(x=prev.x, y=prev.y, z=prev.z)
+        d = self._data_with_prox(False)
+        st, x, y = qp_solve(factors, d, self._q_ef, st,
+                            max_iter=self.sub_max_iter,
+                            eps_abs=self.sub_eps, eps_rel=self.sub_eps)
+        mA = d.A.shape[1] - d.P_diag.shape[1]
+        dual = qp_dual_objective(d, self._q_ef, self._c0_ef, y, mA, x_witness=x)
+        dual = np.asarray(dual)
+        dual = dual[np.isfinite(dual)]
+        return float(dual.max()) if dual.size else None

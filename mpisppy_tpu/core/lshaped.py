@@ -161,9 +161,10 @@ class LShapedMethod(PHBase):
         xf = self.round_nonants(xf)
         self.fix_nonants(xf)
         try:
-            self.solve_loop(w_on=False, prox_on=False, update=False)
+            self.solve_loop(w_on=False, prox_on=False, update=False,
+                            fixed=True)
             tol = float(self.options.get("xhat_feas_tol", 1e-4))
-            st = self._qp_states[False]
+            st = self._qp_states[("fixed", False)]
             feasible = bool(np.all((np.asarray(st.pri_res) <= tol)
                                    | (np.asarray(st.pri_rel) <= tol)))
             ub = self.Eobjective_value() if feasible else None

@@ -93,36 +93,52 @@ class PHBase(SPBase):
         P = self.qp_data.P_diag.at[:, self.nonant_idx].add(self.rho)
         return QPData(P, self.qp_data.A, self.qp_data.l, self.qp_data.u)
 
-    def _get_factors(self, prox_on: bool):
-        """Cached per-prox-toggle factorization (invalidated on rho change)."""
-        key = bool(prox_on)
+    def _get_factors(self, prox_on: bool, fixed: bool = False):
+        """Cached per-mode factorization (invalidated on rho change).
+
+        ``fixed=True`` builds factors for fully-pinned-nonant solves
+        (incumbent evaluation, Benders cut generation): the nonant bound
+        rows become equalities there, and the ADMM per-row rho must be
+        eq-boosted for those rows or the solve crawls. The boost pattern
+        depends only on WHICH rows are equalities, not the pinned values,
+        so one factorization serves every candidate x̂."""
+        key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._factors:
-            self._factors[key] = qp_setup(self._data_with_prox(key), q_ref=self.c)
+            d = self._data_with_prox(prox_on)
+            if fixed:
+                mA = d.A.shape[1] - d.P_diag.shape[1]
+                idx = self.nonant_idx
+                l = d.l.at[:, mA + idx].set(0.0)
+                u = d.u.at[:, mA + idx].set(0.0)
+                d = QPData(d.P_diag, d.A, l, u)
+            self._factors[key] = qp_setup(d, q_ref=self.c)
         return self._factors[key]
 
     def invalidate_factors(self):
         """Call after changing rho (rho setters / NormRhoUpdater)."""
-        self._factors.pop(True, None)
-        self._qp_states.pop(True, None)
+        for cache in (self._factors, self._qp_states):
+            cache.pop(True, None)
+            cache.pop(("fixed", True), None)
         self._step_fns.clear()
 
-    def _ensure_state(self, prox_on=True):
+    def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
         x/y/z warm-start across modes."""
-        key = bool(prox_on)
+        key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._qp_states:
-            st = qp_cold_state(self._get_factors(key))
-            other = self._qp_states.get(not key)
+            st = qp_cold_state(self._get_factors(prox_on, fixed))
+            other = next((v for k, v in self._qp_states.items() if k != key),
+                         None)
             if other is not None:
                 st = st._replace(x=other.x, y=other.y, z=other.z)
             self._qp_states[key] = st
         return self._qp_states[key]
 
     # ------------- the fused PH step -------------
-    def _make_step(self, w_on: bool, prox_on: bool):
+    def _make_step(self, w_on: bool, prox_on: bool, fixed: bool = False):
         """Build the jitted fused iteration for a (w_on, prox_on) mode."""
         data = self._data_with_prox(prox_on)
-        factors = self._get_factors(prox_on)
+        factors = self._get_factors(prox_on, fixed)
         c, c0, prob = self.c, self.c0, self.prob
         idx = self.nonant_idx
         K = self.batch.K
@@ -160,24 +176,26 @@ class PHBase(SPBase):
 
         return step
 
-    def _step(self, w_on: bool, prox_on: bool):
-        key = (w_on, prox_on)
+    def _step(self, w_on: bool, prox_on: bool, fixed: bool = False):
+        key = (w_on, prox_on, fixed)
         if key not in self._step_fns:
-            self._step_fns[key] = self._make_step(w_on, prox_on)
+            self._step_fns[key] = self._make_step(w_on, prox_on, fixed)
         return self._step_fns[key]
 
-    def solve_loop(self, w_on=True, prox_on=True, update=True):
+    def solve_loop(self, w_on=True, prox_on=True, update=True, fixed=False):
         """One batched solve pass in the given mode; mirrors solve_loop
         (ref. phbase.py:999) + Compute_Xbar + Update_W fused. Returns the
         per-scenario *solved* objective (including the W term when w_on,
-        which is what Ebound of a Lagrangian pass needs)."""
-        qp_state = self._ensure_state(prox_on)
-        step = self._step(w_on, prox_on)
+        which is what Ebound of a Lagrangian pass needs). ``fixed=True``
+        selects the eq-boosted factorization for fully-pinned solves."""
+        qp_state = self._ensure_state(prox_on, fixed)
+        step = self._step(w_on, prox_on, fixed)
         (qp_state, x, y, xn, xbar_new, xsqbar_new, W_new, conv,
          base_obj, solved_obj, dual_obj) = step(qp_state, self.W, self.xbar,
                                                 self.rho, self._fixed_mask,
                                                 self._fixed_vals)
-        self._qp_states[bool(prox_on)] = qp_state
+        skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+        self._qp_states[skey] = qp_state
         self.x, self.y = x, y
         if update:
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
@@ -260,8 +278,9 @@ class PHBase(SPBase):
                  getattr(self, "_last_dual_obj", None))
         self.fix_nonants(self.round_nonants(xhat_vals))
         try:
-            self.solve_loop(w_on=False, prox_on=False, update=False)
-            st = self._qp_states[False]
+            self.solve_loop(w_on=False, prox_on=False, update=False,
+                            fixed=True)
+            st = self._qp_states[("fixed", False)]
             pri = np.asarray(st.pri_res)
             rel = np.asarray(st.pri_rel)
             if not np.all((pri <= feas_tol) | (rel <= feas_tol)):

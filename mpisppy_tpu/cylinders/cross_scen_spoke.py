@@ -1,0 +1,58 @@
+"""CrossScenarioCutSpoke: generates Benders rows for the hub's subproblems.
+
+ref. mpisppy/cylinders/cross_scen_spoke.py:11-298: a *general* spoke
+(neither bound type) that receives the hub's nonants, picks the candidate
+x̂ farthest from the cylinder average (ref. :188-214 Allreduce MAX + rank
+vote), generates one Benders cut per scenario at x̂, and flat-packs rows
+``[const, *nonant_coefs]`` back to the hub (the reference also packs an
+eta coefficient; ours is identically 1 by construction and omitted).
+
+The cut engine is the L-shaped machinery: ``LShapedMethod.generate_cuts``
+already produces certified (const, g) pairs from the batched duals at a
+fixed first stage (ref. cross_scen_spoke.py:46-119 builds exactly these
+Benders subproblems over the whole scenario set).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import Spoke, ConvergerSpokeType
+
+
+class CrossScenarioCutSpoke(Spoke):
+    converger_spoke_types = (ConvergerSpokeType.NONANT_GETTER,)
+    converger_spoke_char = "C"
+
+    def local_window_length(self) -> int:
+        S, K = self.opt.batch.S, self.opt.batch.K
+        return S * (1 + K)
+
+    def _select_candidate(self, X):
+        """x̂ = the scenario row farthest (L2) from the prob-weighted mean
+        (ref. cross_scen_spoke.py:188-214)."""
+        prob = np.asarray(self.opt.prob)
+        mean = prob @ X
+        d2 = np.sum((X - mean[None, :]) ** 2, axis=1)
+        return X[int(np.argmax(d2))]
+
+    def main(self):
+        S, K = self.opt.batch.S, self.opt.batch.K
+        self._last_key = None
+        while not self.got_kill_signal():
+            fresh, values = self.spoke_from_hub()
+            if not fresh or values is None:
+                continue
+            _, X = self.unpack_hub(values)
+            xhat = self._select_candidate(X)
+            key = np.asarray(self.opt.round_nonants(xhat)).tobytes()
+            if key == self._last_key:
+                continue
+            self._last_key = key
+            const, g_nonant, _ = self.opt.generate_cuts(xhat)
+            payload = np.concatenate([np.asarray(const).reshape(S, 1),
+                                      np.asarray(g_nonant)], axis=1)
+            self.spoke_to_hub(payload.reshape(-1))
+
+    def finalize(self):
+        return None

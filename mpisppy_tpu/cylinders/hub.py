@@ -170,6 +170,31 @@ class PHHub(Hub):
         self.opt.ph_main(finalize=False)
 
 
+class CrossScenarioHub(PHHub):
+    """PHHub + cut traffic: ships nonants to the cut spoke (via the normal
+    NONANT_GETTER path) and installs received Benders rows on the engine
+    (ref. mpisppy/cylinders/cross_scen_hub.py:11-160). The engine must be a
+    ``CrossScenarioPH``."""
+
+    def setup_hub(self):
+        super().setup_hub()
+        from .cross_scen_spoke import CrossScenarioCutSpoke
+        self.cut_spoke_indices = {i for i, sp in enumerate(self.spokes)
+                                  if isinstance(sp, CrossScenarioCutSpoke)}
+
+    def receive_bounds(self):
+        S, K = self.opt.batch.S, self.opt.batch.K
+        for i in self.cut_spoke_indices:
+            sp = self.spokes[i]
+            values, wid = sp.my_window.read()
+            if wid == sp.my_window.KILL or wid <= self._spoke_last_ids[i]:
+                continue
+            self._spoke_last_ids[i] = wid
+            rows = values.reshape(S, 1 + K)
+            self.opt.add_cuts(rows[:, 0], rows[:, 1:])
+        super().receive_bounds()
+
+
 class APHHub(PHHub):
     """APH as the hub algorithm (ref. hub.py:606-686)."""
 

@@ -1,0 +1,65 @@
+"""CrossScenarioExtension: paces the hub's EF-bound solves.
+
+ref. mpisppy/extensions/cross_scen_extension.py:16-283. The structural work
+(eta variables, EF objective, cut rows) lives in
+``core.cross_scenario.CrossScenarioPH``; this extension reproduces the
+reference's *pacing*: once any cuts exist, attempt a bound check when the
+incumbent has sat unchanged for ``check_bound_improve_iterations``
+iterations, when the outer bound moved, or periodically when fresh cuts
+arrived (ref. :246-262 miditer logic).
+"""
+
+from __future__ import annotations
+
+import math
+
+from .extension import Extension
+
+
+class CrossScenarioExtension(Extension):
+    def __init__(self, options=None):
+        super().__init__(options)
+        cso = self.options.get("cross_scen_options", self.options)
+        self.check_iters = int(cso.get("check_bound_improve_iterations", 10))
+        self.cur_ib = None
+        self.iter_at_cur_ib = 1
+        self.cur_ob = None
+        self.iter_since_last_check = 0
+
+    def post_iter0(self, opt):
+        # iter 0's prox/W-off solve just produced per-scenario wait-and-see
+        # dual bounds: use them as valid eta lower bounds
+        if hasattr(opt, "update_eta_bounds"):
+            opt.update_eta_bounds()
+
+    def miditer(self, opt):
+        if not getattr(opt, "any_cuts", False):
+            return
+        spcomm = opt.spcomm
+        self.iter_since_last_check += 1
+
+        ib = getattr(spcomm, "BestInnerBound", None) if spcomm is not None else None
+        if ib != self.cur_ib:
+            self.cur_ib = ib
+            self.iter_at_cur_ib = 1
+        elif self.cur_ib is not None and math.isfinite(self.cur_ib):
+            self.iter_at_cur_ib += 1
+
+        ob = getattr(spcomm, "BestOuterBound", None) if spcomm is not None else None
+        ob_new = not (self.cur_ob is not None and ob is not None
+                      and math.isclose(ob, self.cur_ob))
+        if ob_new:
+            self.cur_ob = ob
+
+        check = ((self.iter_at_cur_ib == self.check_iters)
+                 or (self.iter_at_cur_ib > self.check_iters and ob_new)
+                 or (self.iter_since_last_check % self.check_iters == 0
+                     and opt.new_cuts))
+        if not check:
+            return
+        bound = opt.solve_ef_bound()
+        opt.new_cuts = False
+        self.iter_since_last_check = 0
+        if bound is not None and spcomm is not None \
+                and hasattr(spcomm, "OuterBoundUpdate"):
+            spcomm.OuterBoundUpdate(bound, char="C")

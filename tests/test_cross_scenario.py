@@ -1,0 +1,118 @@
+"""Cross-scenario cuts: augmentation, cut installation, EF bound, wheel.
+
+Mirrors the reference's cross-scenario showcase (netdes/cs_farmer,
+ref. examples/netdes/netdes_cylinders.py) at test scale: the augmented
+PH engine must behave exactly like plain PH until cuts arrive, installed
+cuts must produce a certified outer bound via the per-subproblem EF
+objective, and the full hub/spoke wheel must exchange cuts live.
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core.cross_scenario import (CrossScenarioPH,
+                                             augment_batch_for_cross_cuts)
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH, PHBase
+from mpisppy_tpu.core.lshaped import LShapedMethod
+from mpisppy_tpu.extensions.cross_scen_extension import CrossScenarioExtension
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import farmer
+
+EF3 = -108390.0
+
+
+def _batch():
+    return build_batch(farmer.scenario_creator, farmer.make_tree(3))
+
+
+def _opts(**kw):
+    o = {"defaultPHrho": 10.0, "PHIterLimit": 10, "convthresh": -1.0,
+         "subproblem_max_iter": 4000, "subproblem_eps": 1e-8}
+    o.update(kw)
+    return o
+
+
+def test_augmentation_shapes_and_eta_pinning():
+    b = _batch()
+    aug = augment_batch_for_cross_cuts(b, max_cut_rounds=4)
+    S, n, m = b.S, b.n, b.m
+    assert aug.n == n + S
+    assert aug.m == m + 4 * S
+    # own eta pinned to zero; others bounded below
+    for k in range(S):
+        assert aug.lb[k, n + k] == 0.0 == aug.ub[k, n + k]
+        other = [s for s in range(S) if s != k]
+        assert np.all(np.isinf(aug.ub[k, [n + s for s in other]]))
+    # placeholder cut rows are eta rows (never all-zero, for equilibration)
+    for r in range(4 * S):
+        assert np.abs(aug.A[:, m + r, :]).sum() > 0
+
+
+def test_cross_ph_matches_plain_ph_before_cuts():
+    """With zero objective weight and free etas, the augmented engine's PH
+    trajectory must match plain PH."""
+    ph = PH(_batch(), _opts(PHIterLimit=3))
+    cph = CrossScenarioPH(_batch(), _opts(PHIterLimit=3))
+    r1 = ph.ph_main()
+    r2 = cph.ph_main()
+    assert r2[2] == pytest.approx(r1[2], abs=2.0)       # trivial bound
+    assert np.allclose(np.asarray(cph.xbar), np.asarray(ph.xbar), atol=1e-3)
+
+
+def test_cuts_give_certified_ef_outer_bound():
+    cph = CrossScenarioPH(_batch(), _opts(PHIterLimit=2))
+    cph.ph_main(finalize=False)
+    cph.update_eta_bounds()
+
+    cutgen = LShapedMethod(_batch(), _opts())
+    # cuts at two candidate first-stage points
+    for xf in (np.asarray(cph.xbar)[0], np.array([100.0, 100.0, 300.0])):
+        const, g, _ = cutgen.generate_cuts(xf)
+        cph.add_cuts(const, g)
+    assert cph.any_cuts
+    bound = cph.solve_ef_bound()
+    assert bound is not None
+    # certified: never above the true EF optimum (tolerance for f64 ADMM)
+    assert bound <= EF3 + abs(EF3) * 1e-3
+    # and the cuts must make it meaningfully better than the eta-lb floor
+    assert bound >= EF3 * 1.5
+
+
+def test_cut_rollover():
+    cph = CrossScenarioPH(_batch(), {"cross_scen_options":
+                                     {"max_cut_rounds": 2},
+                                     **_opts(PHIterLimit=1)})
+    cph.ph_main(finalize=False)
+    cutgen = LShapedMethod(_batch(), _opts())
+    for i in range(4):   # twice the buffer
+        const, g, _ = cutgen.generate_cuts(
+            np.array([50.0 + 20 * i, 80.0, 250.0]))
+        cph.add_cuts(const, g)
+    assert cph._cut_round == 4
+    assert cph.solve_ef_bound() <= EF3 + abs(EF3) * 1e-3
+
+
+def test_cross_scenario_wheel():
+    from mpisppy_tpu.cylinders.hub import CrossScenarioHub
+    from mpisppy_tpu.cylinders.cross_scen_spoke import CrossScenarioCutSpoke
+    from mpisppy_tpu.cylinders.xhat_bounders import XhatShuffleInnerBound
+    from mpisppy_tpu.utils.sputils import spin_the_wheel
+
+    ext = CrossScenarioExtension({"cross_scen_options":
+                                  {"check_bound_improve_iterations": 2}})
+    wheel = spin_the_wheel(
+        {"hub_class": CrossScenarioHub, "hub_kwargs": {"options": {}},
+         "opt_class": CrossScenarioPH,
+         "opt_kwargs": {"batch": _batch(),
+                        "options": _opts(PHIterLimit=25),
+                        "extensions": ext}},
+        [{"spoke_class": CrossScenarioCutSpoke, "opt_class": LShapedMethod,
+          "opt_kwargs": {"batch": _batch(), "options": _opts()}},
+         {"spoke_class": XhatShuffleInnerBound, "opt_class": PHBase,
+          "opt_kwargs": {"batch": _batch(), "options": _opts()}}])
+    hub = wheel.hub
+    # cuts must have arrived and bounds must sandwich the EF optimum
+    assert hub.opt.any_cuts or hub.opt._cut_round > 0
+    assert hub.BestOuterBound <= EF3 + 1.0
+    assert wheel.best_inner_bound >= EF3 - 1.0
