@@ -81,7 +81,7 @@ class APH(PHBase):
     """Asynchronous Projective Hedging engine (ref. mpisppy/opt/aph.py:54).
 
     The reference's ``y`` (dual estimate) is named ``y_aph`` here because
-    PHBase.y already carries the QP constraint duals of the last solve.
+    PHBase.yA/yB already carry the QP duals of the last solve.
     """
 
     def __init__(self, batch, options=None, **kw):
@@ -129,7 +129,8 @@ class APH(PHBase):
         W_solve = self._W_lag if self.use_lag else self.W
         z_solve = self._z_lag if self.use_lag else self.z
         saved_xbar, saved_W = self.xbar, self.W
-        x_old, y_old = self.x, self.y
+        x_old = self.x
+        yA_old, yB_old = getattr(self, "yA", None), getattr(self, "yB", None)
         self.xbar, self.W = z_solve, W_solve   # prox center := z
         try:
             self.solve_loop(w_on=True, prox_on=True, update=False)
@@ -137,8 +138,9 @@ class APH(PHBase):
             self.xbar, self.W = saved_xbar, saved_W
         m = jnp.asarray(mask)[:, None]
         self.x = jnp.where(m, self.x, x_old)
-        if y_old is not None:
-            self.y = jnp.where(m, self.y, y_old)
+        if yA_old is not None:
+            self.yA = jnp.where(m, self.yA, yA_old)
+            self.yB = jnp.where(m, self.yB, yB_old)
         if self.use_lag:
             # lag: dispatched scenarios pick up current (W, z) for their
             # NEXT solve (ref. aph.py:671-683 _update_foropt)

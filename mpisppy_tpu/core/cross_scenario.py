@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import (QPData, fold_bounds, qp_setup, qp_solve,
+from ..ops.qp_solver import (QPData, qp_setup, qp_solve,
                              qp_cold_state, qp_dual_objective)
 from .ph import PH
 
@@ -98,18 +98,24 @@ class CrossScenarioPH(PH):
         self._cut_round = 0
         self.new_cuts = False
         self.any_cuts = False
-        # EF-mode linear term: stage-1 coefs unscaled + p_k * later-stage
-        # coefs + p_s on the eta columns (own eta pinned to 0 anyway)
+        # EF-mode linear term in subproblem k:  p_k·c_k on the original
+        # columns + p_j on the OTHER scenarios' eta columns (own eta pinned
+        # to 0). The cuts produced by LShapedMethod.generate_cuts minorize
+        # the FULL scenario value V_j(x) (stage-1 cost included), so
+        # eta_j >= V_j(x) and  p_k·f_k(x) + Σ_{j≠k} p_j·eta_j <= EF(x):
+        # the subproblem optimum lower-bounds the EF optimum. (The
+        # reference instead strips stage-1 costs from its L-shaped
+        # subproblems, ref. opt/lshaped.py:413-423, and prices stage-1 at
+        # full weight — mixing the two conventions would double-count
+        # (1-p_k)·c1·x.)
         b = self.batch
         S, n = b.S, self._n_orig
-        c1 = np.asarray(b.c_stage)[:, 0, :]
-        c_ef = c1 + np.asarray(b.prob)[:, None] * (np.asarray(b.c) - c1)
+        c_ef = np.asarray(b.prob)[:, None] * np.asarray(b.c)
         c_ef[:, n:] = np.asarray(b.prob)[None, :]
         c_ef[np.arange(S), n + np.arange(S)] = 0.0
         self._q_ef = jnp.asarray(c_ef, self.dtype)
-        c01 = np.asarray(b.c0_stage)[:, 0]
-        self._c0_ef = jnp.asarray(
-            c01 + np.asarray(b.prob) * (np.asarray(b.c0) - c01), self.dtype)
+        self._c0_ef = jnp.asarray(np.asarray(b.prob) * np.asarray(b.c0),
+                                  self.dtype)
 
     # ---- cut installation (ref. cross_scen_hub.py:73-160) ----
     def add_cuts(self, const, g_nonant):
@@ -139,11 +145,12 @@ class CrossScenarioPH(PH):
         self._cut_round += 1
         self.any_cuts = True
         self.new_cuts = True
-        # refactorize: rebuild folded data and drop every per-mode cache
+        # refactorize: rebuild the data block and drop every per-mode cache
+        # (cut rows differ per scenario, so the batch is unshared from here)
         t = self.dtype
-        self.qp_data = fold_bounds(self.P_diag, jnp.asarray(A, t),
-                                   jnp.asarray(l, t), jnp.asarray(u, t),
-                                   jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
+        self.qp_data = QPData(self.P_diag, jnp.asarray(A, t),
+                              jnp.asarray(l, t), jnp.asarray(u, t),
+                              jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
         self._factors.clear()
         self._qp_states.clear()
         self._step_fns.clear()
@@ -167,9 +174,9 @@ class CrossScenarioPH(PH):
         lb[np.arange(S), n + np.arange(S)] = 0.0
         b.lb = lb
         t = self.dtype
-        self.qp_data = fold_bounds(self.P_diag, jnp.asarray(b.A, t),
-                                   jnp.asarray(b.l, t), jnp.asarray(b.u, t),
-                                   jnp.asarray(lb, t), jnp.asarray(b.ub, t))
+        self.qp_data = QPData(self.P_diag, jnp.asarray(b.A, t),
+                              jnp.asarray(b.l, t), jnp.asarray(b.u, t),
+                              jnp.asarray(lb, t), jnp.asarray(b.ub, t))
         self._factors.clear()
         self._qp_states.clear()
         self._step_fns.clear()
@@ -179,17 +186,17 @@ class CrossScenarioPH(PH):
         """Solve every subproblem under the EF objective (own scenario exact
         + eta epigraphs for the rest); each certified dual objective lower-
         bounds the EF optimum, and the MAX over subproblems is returned."""
-        factors = self._get_factors(False)
-        st = qp_cold_state(factors)
+        factors, d = self._get_factors(False)
+        st = qp_cold_state(factors, d)
         prev = self._qp_states.get(False)
         if prev is not None:
-            st = st._replace(x=prev.x, y=prev.y, z=prev.z)
-        d = self._data_with_prox(False)
-        st, x, y = qp_solve(factors, d, self._q_ef, st,
-                            max_iter=self.sub_max_iter,
-                            eps_abs=self.sub_eps, eps_rel=self.sub_eps)
-        mA = d.A.shape[1] - d.P_diag.shape[1]
-        dual = qp_dual_objective(d, self._q_ef, self._c0_ef, y, mA, x_witness=x)
+            st = st._replace(x=prev.x, yA=prev.yA, yB=prev.yB,
+                             zA=prev.zA, zB=prev.zB)
+        st, x, yA, yB = qp_solve(factors, d, self._q_ef, st,
+                                 max_iter=self.sub_max_iter,
+                                 eps_abs=self.sub_eps, eps_rel=self.sub_eps)
+        dual = qp_dual_objective(d, self._q_ef, self._c0_ef, yA, yB,
+                                 x_witness=x)
         dual = np.asarray(dual)
         dual = dual[np.isfinite(dual)]
         return float(dual.max()) if dual.size else None

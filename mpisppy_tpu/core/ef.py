@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import QPData, fold_bounds, qp_setup, qp_solve, qp_cold_state
+from ..ops.qp_solver import QPData, qp_setup, qp_solve, qp_cold_state
 from .spbase import SPBase
 
 
@@ -85,7 +85,7 @@ class ExtensiveForm(SPBase):
         self.c0_ef = float(np.dot(b.prob, b.c0))
 
         t = self.dtype
-        self.ef_data: QPData = fold_bounds(
+        self.ef_data: QPData = QPData(
             jnp.asarray(P_ef, t)[None], jnp.asarray(A_ef, t)[None],
             jnp.asarray(l_ef, t)[None], jnp.asarray(u_ef, t)[None],
             jnp.asarray(lb_ef, t)[None], jnp.asarray(ub_ef, t)[None])
@@ -95,9 +95,10 @@ class ExtensiveForm(SPBase):
         """Solve the EF; mirrors opt/ef.py:61. Returns (objective, x_batch)
         where x_batch is the per-scenario (S, n) solution block."""
         factors = qp_setup(self.ef_data, q_ref=self.c_ef)
-        st = qp_cold_state(factors)
-        st, x_ef, _ = qp_solve(factors, self.ef_data, self.c_ef, st,
-                               max_iter=max_iter, eps_abs=eps_abs, eps_rel=eps_rel)
+        st = qp_cold_state(factors, self.ef_data)
+        st, x_ef, _, _ = qp_solve(factors, self.ef_data, self.c_ef, st,
+                                  max_iter=max_iter, eps_abs=eps_abs,
+                                  eps_rel=eps_rel)
         self.solver_state = st
         x_ef = np.asarray(x_ef[0])
         x_batch = x_ef[self.colmap]  # (S, n)
@@ -108,7 +109,6 @@ class ExtensiveForm(SPBase):
 
     def get_objective_value(self):
         """User-sense objective (ref. opt/ef.py:102 get_root_solution)."""
-        obj, _ = getattr(self, "_cached", (None, None))
         if not hasattr(self, "x_batch"):
             raise RuntimeError("call solve_extensive_form first")
         obj = float(self.Eobjective(self.scenario_objectives(

@@ -168,17 +168,16 @@ class LShapedMethod(PHBase):
             feasible = bool(np.all((np.asarray(st.pri_res) <= tol)
                                    | (np.asarray(st.pri_rel) <= tol)))
             ub = self.Eobjective_value() if feasible else None
-            # rebuild the pinned-bound data the step used for the duals
+            # rebuild the pinned-box data the step used for the duals
             d0 = self._data_with_prox(False)
-            mA = d0.A.shape[1] - d0.P_diag.shape[1]
             idx = self.nonant_idx
             fixed = jnp.broadcast_to(jnp.asarray(xf, self.dtype), (b.S, b.K))
-            bl = d0.l.at[:, mA + idx].set(fixed)
-            bu = d0.u.at[:, mA + idx].set(fixed)
-            d = QPData(d0.P_diag, d0.A, bl, bu)
+            d = d0._replace(lb=d0.lb.at[:, idx].set(fixed),
+                            ub=d0.ub.at[:, idx].set(fixed))
             pmask = jnp.zeros(b.n, bool).at[idx].set(True)
             b0 = jnp.zeros((b.S, b.n), self.dtype).at[:, idx].set(fixed)
-            const, g = benders_cut(d, self.c, self.c0, self.y, mA, pmask, b0)
+            const, g = benders_cut(d, self.c, self.c0, self.yA, self.yB,
+                                   pmask, b0)
             g_nonant = np.asarray(g)[:, np.asarray(b.nonant_idx)]
             return np.asarray(const), g_nonant, ub
         finally:

@@ -37,7 +37,7 @@ from .. import global_toc
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_cold_state,
                              qp_dual_objective)
-from .spbase import SPBase
+from .spbase import SPBase, compute_xbar
 
 
 class PHBase(SPBase):
@@ -90,28 +90,46 @@ class PHBase(SPBase):
     def _data_with_prox(self, prox_on: bool) -> QPData:
         if not prox_on:
             return self.qp_data
-        P = self.qp_data.P_diag.at[:, self.nonant_idx].add(self.rho)
-        return QPData(P, self.qp_data.A, self.qp_data.l, self.qp_data.u)
+        d = self.qp_data
+        if d.P_diag.ndim == 1:
+            # shared-structure batch: the prox diagonal must stay shared for
+            # the single-factor path, which it is whenever rho is uniform
+            # across scenarios (the default; rho setters are per-variable)
+            rho_np = np.asarray(self.rho)
+            if (rho_np == rho_np[:1]).all():
+                P = d.P_diag.at[self.nonant_idx].add(
+                    jnp.asarray(rho_np[0], self.dtype))
+                return d._replace(P_diag=P)
+            # per-scenario rho: fall back to the batched representation
+            S = self.batch.S
+            P = jnp.broadcast_to(d.P_diag, (S,) + d.P_diag.shape) \
+                .at[:, self.nonant_idx].add(self.rho)
+            A = jnp.broadcast_to(d.A, (S,) + d.A.shape)
+            return d._replace(P_diag=P, A=A)
+        P = d.P_diag.at[:, self.nonant_idx].add(self.rho)
+        return d._replace(P_diag=P)
 
     def _get_factors(self, prox_on: bool, fixed: bool = False):
         """Cached per-mode factorization (invalidated on rho change).
 
         ``fixed=True`` builds factors for fully-pinned-nonant solves
-        (incumbent evaluation, Benders cut generation): the nonant bound
-        rows become equalities there, and the ADMM per-row rho must be
-        eq-boosted for those rows or the solve crawls. The boost pattern
-        depends only on WHICH rows are equalities, not the pinned values,
+        (incumbent evaluation, Benders cut generation): the nonant boxes
+        become equalities there, and the ADMM bound-row rho must be
+        eq-boosted for those columns or the solve crawls. The boost pattern
+        depends only on WHICH columns are pinned, not the pinned values,
         so one factorization serves every candidate x̂."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._factors:
             d = self._data_with_prox(prox_on)
+            d_setup = d
             if fixed:
-                mA = d.A.shape[1] - d.P_diag.shape[1]
+                # pin the boxes only for the rho-pattern detection; the
+                # cached data stays unpinned (the step applies fixed_vals
+                # through fixed_mask at solve time)
                 idx = self.nonant_idx
-                l = d.l.at[:, mA + idx].set(0.0)
-                u = d.u.at[:, mA + idx].set(0.0)
-                d = QPData(d.P_diag, d.A, l, u)
-            self._factors[key] = qp_setup(d, q_ref=self.c)
+                d_setup = d._replace(lb=d.lb.at[:, idx].set(0.0),
+                                     ub=d.ub.at[:, idx].set(0.0))
+            self._factors[key] = (qp_setup(d_setup, q_ref=self.c), d)
         return self._factors[key]
 
     def invalidate_factors(self):
@@ -119,62 +137,76 @@ class PHBase(SPBase):
         for cache in (self._factors, self._qp_states):
             cache.pop(True, None)
             cache.pop(("fixed", True), None)
-        self._step_fns.clear()
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
         x/y/z warm-start across modes."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._qp_states:
-            st = qp_cold_state(self._get_factors(prox_on, fixed))
+            factors, d = self._get_factors(prox_on, fixed)
+            st = qp_cold_state(factors, d)
             other = next((v for k, v in self._qp_states.items() if k != key),
                          None)
-            if other is not None:
-                st = st._replace(x=other.x, y=other.y, z=other.z)
+            if other is not None and other.x.shape == st.x.shape \
+                    and other.zA.shape == st.zA.shape:
+                # copy: the transplanted buffers will be DONATED by the next
+                # step call, and the source state must survive it
+                cp = jnp.copy
+                st = st._replace(x=cp(other.x), yA=cp(other.yA),
+                                 yB=cp(other.yB), zA=cp(other.zA),
+                                 zB=cp(other.zB))
             self._qp_states[key] = st
         return self._qp_states[key]
 
     # ------------- the fused PH step -------------
     def _make_step(self, w_on: bool, prox_on: bool, fixed: bool = False):
-        """Build the jitted fused iteration for a (w_on, prox_on) mode."""
-        data = self._data_with_prox(prox_on)
-        factors = self._get_factors(prox_on, fixed)
-        c, c0, prob = self.c, self.c0, self.prob
+        """Build the jitted fused iteration for a (w_on, prox_on) mode.
+
+        Everything large — the factorization artifacts, the constraint
+        data, the cost block — enters as an ARGUMENT, not a closure
+        constant: closing over batch tensors would bake them into the
+        lowered program as literals (gigabytes of constants at UC scale)
+        and defeat buffer donation. Only scalars and the (K,) index vector
+        are captured."""
         idx = self.nonant_idx
         K = self.batch.K
         sub_max_iter, sub_eps = self.sub_max_iter, self.sub_eps
-        compute_xbar = self.compute_xbar
+        sub_polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
+        slot_slices = tuple(self.slot_slices)
 
-        @jax.jit
-        def step(qp_state, W, xbar, rho, fixed_mask, fixed_vals):
+        def xbar_of(memberships, prob, xn):
+            return compute_xbar(memberships, slot_slices, prob, xn)
+
+        def step(qp_state, factors, data, c, c0, P0, prob, memberships,
+                 W, xbar, rho, fixed_mask, fixed_vals):
             wvec = W - rho * xbar if (w_on and prox_on) else (
                 W if w_on else (-rho * xbar if prox_on else jnp.zeros_like(W)))
             q = c.at[:, idx].add(wvec)
-            # fixed nonants: pin bounds (ref. phbase.py:413 _fix_nonants)
-            mA = data.A.shape[1] - data.P_diag.shape[1]  # rows before bound rows
-            bl = data.l.at[:, mA + idx].set(
-                jnp.where(fixed_mask, fixed_vals, data.l[:, mA + idx]))
-            bu = data.u.at[:, mA + idx].set(
-                jnp.where(fixed_mask, fixed_vals, data.u[:, mA + idx]))
-            d = QPData(data.P_diag, data.A, bl, bu)
-            qp_state, x, y = qp_solve(factors, d, q, qp_state,
-                                      max_iter=sub_max_iter,
-                                      eps_abs=sub_eps, eps_rel=sub_eps)
+            # fixed nonants: pin boxes (ref. phbase.py:413 _fix_nonants)
+            bl = data.lb.at[:, idx].set(
+                jnp.where(fixed_mask, fixed_vals, data.lb[:, idx]))
+            bu = data.ub.at[:, idx].set(
+                jnp.where(fixed_mask, fixed_vals, data.ub[:, idx]))
+            d = data._replace(lb=bl, ub=bu)
+            qp_state, x, yA, yB = qp_solve(factors, d, q, qp_state,
+                                           max_iter=sub_max_iter,
+                                           eps_abs=sub_eps, eps_rel=sub_eps,
+                                           polish_chunk=sub_polish_chunk)
             xn = x[:, idx]
-            xbar_new = compute_xbar(xn)
-            xsqbar_new = compute_xbar(xn * xn)
+            xbar_new = xbar_of(memberships, prob, xn)
+            xsqbar_new = xbar_of(memberships, prob, xn * xn)
             W_new = W + rho * (xn - xbar_new)
             conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
             base_obj = jnp.sum(c * x, axis=1) + c0 \
-                + 0.5 * jnp.sum(self.P_diag * x * x, axis=1)
+                + 0.5 * jnp.sum(P0 * x * x, axis=1)
             solved_obj = base_obj + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
             # certified lower bound on each subproblem's optimum (valid for
             # prox-off solves; see qp_dual_objective)
-            dual_obj = qp_dual_objective(d, q, c0, y, mA, x_witness=x)
-            return qp_state, x, y, xn, xbar_new, xsqbar_new, W_new, conv, \
-                base_obj, solved_obj, dual_obj
+            dual_obj = qp_dual_objective(d, q, c0, yA, yB, x_witness=x)
+            return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
+                conv, base_obj, solved_obj, dual_obj
 
-        return step
+        return jax.jit(step, donate_argnums=(0,))
 
     def _step(self, w_on: bool, prox_on: bool, fixed: bool = False):
         key = (w_on, prox_on, fixed)
@@ -190,13 +222,15 @@ class PHBase(SPBase):
         selects the eq-boosted factorization for fully-pinned solves."""
         qp_state = self._ensure_state(prox_on, fixed)
         step = self._step(w_on, prox_on, fixed)
-        (qp_state, x, y, xn, xbar_new, xsqbar_new, W_new, conv,
-         base_obj, solved_obj, dual_obj) = step(qp_state, self.W, self.xbar,
-                                                self.rho, self._fixed_mask,
-                                                self._fixed_vals)
+        factors, data = self._get_factors(prox_on, fixed)
+        (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
+         base_obj, solved_obj, dual_obj) = step(
+            qp_state, factors, data, self.c, self.c0, self.P_diag,
+            self.prob, tuple(self.memberships), self.W, self.xbar,
+            self.rho, self._fixed_mask, self._fixed_vals)
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         self._qp_states[skey] = qp_state
-        self.x, self.y = x, y
+        self.x, self.yA, self.yB = x, yA, yB
         if update:
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
@@ -273,7 +307,8 @@ class PHBase(SPBase):
         # subproblem solutions the hub ships / convergers read, nor wipe a
         # Fixer's pinned slots
         saved = (self._fixed_mask, self._fixed_vals, self.x,
-                 getattr(self, "y", None), getattr(self, "_last_base_obj", None),
+                 getattr(self, "yA", None), getattr(self, "yB", None),
+                 getattr(self, "_last_base_obj", None),
                  getattr(self, "_last_solved_obj", None),
                  getattr(self, "_last_dual_obj", None))
         self.fix_nonants(self.round_nonants(xhat_vals))
@@ -287,7 +322,7 @@ class PHBase(SPBase):
                 return None
             return self.Eobjective_value()
         finally:
-            (self._fixed_mask, self._fixed_vals, self.x, self.y,
+            (self._fixed_mask, self._fixed_vals, self.x, self.yA, self.yB,
              self._last_base_obj, self._last_solved_obj,
              self._last_dual_obj) = saved
 

@@ -22,7 +22,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import QPData, fold_bounds
+from ..ops.qp_solver import QPData
+
+
+def compute_xbar(memberships, slot_slices, prob, xn):
+    """Nonanticipative mean per tree node, broadcast back to scenarios.
+
+    xn: (S, K) nonant slots. Per non-leaf stage t with membership B_t:
+    xbar = B_t (B_tᵀ(p⊙x) / B_tᵀp) — dense matmuls that become
+    local-matmul + psum when the scenario axis is sharded. This replaces
+    the per-node MPI Allreduce in Compute_Xbar (ref. phbase.py:144-221).
+    Free function so jitted steps can take memberships/prob as ARGUMENTS
+    (not baked-in constants); SPBase.compute_xbar wraps it."""
+    outs = []
+    for B, sl in zip(memberships, slot_slices):
+        xt = xn[:, sl]
+        pnode = B.T @ prob
+        num = B.T @ (prob[:, None] * xt)
+        outs.append(B @ (num / pnode[:, None]))
+    return jnp.concatenate(outs, axis=1)
 
 
 class SPBase:
@@ -54,24 +72,48 @@ class SPBase:
         self.c0_stage = jnp.asarray(b.c0_stage, t)
         self.nonant_idx = jnp.asarray(b.nonant_idx)
         self.P_diag = jnp.asarray(b.P_diag, t)
-        self.qp_data: QPData = fold_bounds(
-            self.P_diag, jnp.asarray(b.A, t), jnp.asarray(b.l, t),
-            jnp.asarray(b.u, t), jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
+        # shared-structure detection: when every scenario carries the SAME
+        # constraint matrix and quadratic (only c/l/u/lb/ub differ — true
+        # for uc/sizes/sslp/hydro where randomness enters the rhs), store A
+        # and P unbatched so the kernel factors ONE (n, n) KKT matrix for
+        # the whole batch (see ops/qp_solver.py module docstring). This is
+        # the representation that reaches the reference's 1000-scenario
+        # north star (ref. paperruns/larger_uc/1000scenarios_wind).
+        A_np, P_np = np.asarray(b.A), np.asarray(b.P_diag)
+        self.shared_structure = bool(
+            b.S > 1 and (A_np == A_np[0]).all() and (P_np == P_np[0]).all())
+        if self.shared_structure:
+            A_dev = jnp.asarray(A_np[0], t)
+            P_dev = jnp.asarray(P_np[0], t)
+        else:
+            A_dev = jnp.asarray(A_np, t)
+            P_dev = self.P_diag
+        self.qp_data: QPData = QPData(
+            P_dev, A_dev, jnp.asarray(b.l, t), jnp.asarray(b.u, t),
+            jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
         # per-stage membership matrices for nonant reductions
         self.memberships = [jnp.asarray(b.tree.membership(s + 1), t)
                             for s in range(b.tree.num_stages - 1)]
         self.slot_slices = b.stage_slot_slices
 
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel.mesh import scenario_sharding
             shard = lambda a: jax.device_put(a, scenario_sharding(mesh, a.ndim))
+            repl = lambda a: jax.device_put(
+                a, NamedSharding(mesh, PartitionSpec(*([None] * a.ndim))))
             self.prob = shard(self.prob)
             self.c = shard(self.c)
             self.c0 = shard(self.c0)
             self.c_stage = shard(self.c_stage)
             self.c0_stage = shard(self.c0_stage)
             self.P_diag = shard(self.P_diag)
-            self.qp_data = type(self.qp_data)(*[shard(a) for a in self.qp_data])
+            # shared (unbatched) fields replicate; batched fields shard on
+            # the scenario axis
+            batched_ndim = dict(P_diag=2, A=3, l=2, u=2, lb=2, ub=2)
+            self.qp_data = QPData(**{
+                k: (shard(a) if a.ndim == batched_ndim[k] else repl(a))
+                for k, a in self.qp_data._asdict().items()})
             self.memberships = [shard(B) for B in self.memberships]
 
     # ---- reductions (the reference's Allreduce family) ----
@@ -85,20 +127,9 @@ class SPBase:
         return quad + jnp.sum(self.c * x, axis=-1) + self.c0
 
     def compute_xbar(self, xn):
-        """Nonanticipative mean per tree node, broadcast back to scenarios.
-
-        xn: (S, K) nonant slots. Per non-leaf stage t with membership B_t:
-        xbar = B_t (B_tᵀ(p⊙x) / B_tᵀp) — dense matmuls that become
-        local-matmul + psum when the scenario axis is sharded. This replaces
-        the per-node MPI Allreduce in Compute_Xbar (ref. phbase.py:144-221).
-        """
-        outs = []
-        for B, sl in zip(self.memberships, self.slot_slices):
-            xt = xn[:, sl]
-            pnode = B.T @ self.prob
-            num = B.T @ (self.prob[:, None] * xt)
-            outs.append(B @ (num / pnode[:, None]))
-        return jnp.concatenate(outs, axis=1)
+        """See the module-level compute_xbar (single implementation)."""
+        return compute_xbar(self.memberships, self.slot_slices, self.prob,
+                            xn)
 
     def nonants_of(self, x):
         return x[..., self.nonant_idx]

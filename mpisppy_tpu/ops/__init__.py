@@ -1,2 +1,3 @@
 from .qp_solver import (QPData, QPFactors, QPState, qp_setup, qp_solve,  # noqa: F401
-                        qp_cold_state, fold_bounds, qp_objective)
+                        qp_cold_state, qp_objective, qp_dual_objective,
+                        benders_cut)

@@ -9,18 +9,31 @@ the TPU: every operation below is a batched matmul / triangular solve /
 elementwise op over the leading scenario axis, so S scenarios cost one MXU
 pass, not S solver calls.
 
-Form:   min ½ xᵀ diag(P) x + qᵀx   s.t.  l ≤ A x ≤ u
-(variable bounds are folded into A as identity rows by ``fold_bounds``).
+Form:   min ½ xᵀ diag(P) x + qᵀx   s.t.  l ≤ A x ≤ u,  lb ≤ x ≤ ub.
+
+Variable boxes are handled NATIVELY in the ADMM splitting (they are a
+second, diagonal constraint block), not folded into A as identity rows:
+the identity block's KKT contribution is a pure diagonal, so the fold
+would only double the row count and materialize (S, n, n) of zeros.
+
+Structure sharing: ``A`` (and ``P_diag``) may be given UNBATCHED —
+``A (m, n)``, ``P_diag (n,)`` — when every scenario shares the same
+matrix and only (c, l, u, lb, ub) differ (true for UC/sizes/sslp/hydro,
+where scenarios differ in the rhs only). The KKT factorization is then a
+single shared (n, n) Cholesky instead of (S, n, n), the per-iteration
+matmuls become one (m, n) × (n, S) MXU pass, and HBM stops scaling as
+S·n² — this is what makes the 1000-scenario north star
+(ref. paperruns/larger_uc/1000scenarios_wind) fit one chip.
 
 Method: ADMM as in OSQP (Stellato et al. 2020) with
- - Ruiz equilibration of the KKT matrix plus cost normalization,
- - per-row stepsize rho (boosted on equality rows) with OSQP's adaptive
-   rho rule: rho <- rho * sqrt(rel_pri_res / rel_dua_res), refactorizing
-   the KKT matrix inside the solve loop when the change exceeds 5x,
- - a dense Cholesky factor of M = diag(P) + sigma*I + A'diag(rho)A carried
-   in the *solver state*: PH iterations change only q (W and the prox
-   center x-bar), so both the factor and the adapted rho persist across
-   warm-started solves and refactorization becomes rare at steady state,
+ - Ruiz equilibration of the KKT matrix (bound rows enter analytically),
+ - per-row stepsize rho (boosted on equality rows/fixed columns) with
+   OSQP's adaptive rho rule, refactorizing inside the solve loop when the
+   change exceeds 5x (tied to a single scalar in shared-structure mode so
+   the factor stays shared),
+ - the Cholesky factor of M = diag(P) + sigma*I + Aᵀdiag(ρ_A)A + diag(g²ρ_b)
+   carried in the *solver state*: PH iterations change only q, so the
+   factor and adapted rho persist across warm-started solves,
  - periodic residual checks inside a lax.while_loop (compiler-friendly
    control flow; no Python in the loop).
 
@@ -28,6 +41,14 @@ Why ADMM and not simplex/IPM: the iteration is pure BLAS-3 over the batch
 (MXU-friendly, no pivoting/branching), tolerances ~1e-6..1e-8 in f64 and
 ~1e-4 in f32 are ample for PH/bounding, and the factor-caching matches PH's
 access pattern exactly.
+
+Known limitation: on scenarios whose optimum is DEGENERATE (more active
+constraints than variables), the polished duals retain O(dual tolerance)
+residual components along the rank-deficient directions, and the
+certified dual bound is then loose by ~1e-4 RELATIVE (residual times the
+widest variable box). Non-degenerate scenarios polish to machine-level
+exactness. 1e-4 relative matches the reference's own target MIP gaps
+(0.01-0.07%, see BASELINE.md), and the bound stays VALID either way.
 """
 
 from __future__ import annotations
@@ -40,121 +61,121 @@ import jax.numpy as jnp
 
 
 class QPData(NamedTuple):
-    """Stacked problem data; leading axis S = scenarios."""
-    P_diag: jax.Array   # (S, n)
-    A: jax.Array        # (S, m, n) with bound rows folded in
+    """Stacked problem data; leading axis S = scenarios. ``A`` and
+    ``P_diag`` may be unbatched ((m, n) / (n,)) when shared across the
+    batch — see the module docstring."""
+    P_diag: jax.Array   # (S, n) or (n,) shared
+    A: jax.Array        # (S, m, n) or (m, n) shared
     l: jax.Array        # (S, m)
     u: jax.Array        # (S, m)
+    lb: jax.Array       # (S, n)
+    ub: jax.Array       # (S, n)
 
 
 class QPFactors(NamedTuple):
-    """Static setup artifacts (scaling + scaled matrices)."""
+    """Static setup artifacts (scaling + scaled matrices). Shapes follow
+    QPData's sharing: batched (S, ...) or shared (no S axis)."""
     sigma: jax.Array       # scalar
-    D: jax.Array           # (S, n) column equilibration
-    E: jax.Array           # (S, m) row equilibration
-    cost_scale: jax.Array  # (S,) objective scaling
-    A_s: jax.Array         # (S, m, n) scaled A
-    P_s: jax.Array         # (S, n) scaled P diagonal
-    rho_pattern: jax.Array  # (S, m) relative per-row rho (eq rows boosted)
+    D: jax.Array           # (S, n) | (n,) column equilibration
+    E: jax.Array           # (S, m) | (m,) row equilibration (A rows)
+    Eb: jax.Array          # (S, n) | (n,) row equilibration (bound rows)
+    cost_scale: jax.Array  # (S,) | () objective scaling
+    A_s: jax.Array         # (S, m, n) | (m, n) scaled A
+    P_s: jax.Array         # (S, n) | (n,) scaled P diagonal
+    rho_A: jax.Array       # (S, m) | (m,) relative per-row rho (eq boosted)
+    rho_b: jax.Array       # (S, n) | (n,) bound-row rho (fixed cols boosted)
 
 
 class QPState(NamedTuple):
     """Warm-startable solver state; L and rho persist across solves."""
-    x: jax.Array        # (S, n) scaled iterate
-    y: jax.Array        # (S, m) scaled dual
-    z: jax.Array        # (S, m) scaled slack
-    L: jax.Array        # (S, n, n) Cholesky factor of current KKT matrix
-    rho_scale: jax.Array  # (S,) scalar multiplier on rho_pattern
-    iters: jax.Array    # scalar total ADMM iterations in last solve
-    pri_res: jax.Array  # (S,) unscaled
-    dua_res: jax.Array  # (S,) unscaled
-    pri_rel: jax.Array  # (S,) pri_res / problem scale (feasibility metric)
+    x: jax.Array          # (S, n) scaled iterate
+    yA: jax.Array         # (S, m) scaled row duals
+    yB: jax.Array         # (S, n) scaled bound duals
+    zA: jax.Array         # (S, m) scaled row slacks
+    zB: jax.Array         # (S, n) scaled bound slacks
+    L: jax.Array          # (S, n, n) | (n, n) Cholesky of current KKT matrix
+    rho_scale: jax.Array  # (S,) | () multiplier on the rho patterns
+    iters: jax.Array      # scalar total ADMM iterations in last solve
+    pri_res: jax.Array    # (S,) unscaled
+    dua_res: jax.Array    # (S,) unscaled
+    pri_rel: jax.Array    # (S,) pri_res / problem scale (feasibility metric)
 
 
-def fold_bounds(P_diag, A, l, u, lb, ub):
-    """Append identity rows for variable bounds -> pure two-sided row form."""
-    S, m, n = A.shape
-    eye = jnp.broadcast_to(jnp.eye(n, dtype=A.dtype), (S, n, n))
-    return QPData(
-        P_diag=jnp.asarray(P_diag),
-        A=jnp.concatenate([A, eye], axis=1),
-        l=jnp.concatenate([l, lb], axis=1),
-        u=jnp.concatenate([u, ub], axis=1),
-    )
+def _Ax(A, x):
+    """A x with A (m,n) shared or (S,m,n) batched; x (S,n) -> (S,m)."""
+    if A.ndim == 2:
+        return x @ A.T
+    return jnp.einsum("smn,sn->sm", A, x)
+
+
+def _ATy(A, y):
+    """Aᵀ y with A (m,n) shared or (S,m,n) batched; y (S,m) -> (S,n)."""
+    if A.ndim == 2:
+        return y @ A
+    return jnp.einsum("smn,sm->sn", A, y)
 
 
 def _ruiz_equilibrate(P_diag, A, iters=15):
-    """Modified Ruiz equilibration of the KKT matrix [[P, A'],[A, 0]].
-    Returns (D, E) with scaled P = D P D (diag), A = E A D, all batched."""
-    S, m, n = A.shape
-    D = jnp.ones((S, n), A.dtype)
-    E = jnp.ones((S, m), A.dtype)
+    """Modified Ruiz equilibration of the KKT matrix [[P, Āᵀ],[Ā, 0]] with
+    Ā = [A; I] — the identity (bound-row) block is handled analytically:
+    its scaled row j is the single value g_j = Eb_j·D_j. Returns (D, E, Eb)
+    with scaled P = D P D (diag), A = E A D, bound rows = diag(Eb·D)."""
+    n = A.shape[-1]
+    m = A.shape[-2]
+    bshape = A.shape[:-2]
+    D = jnp.ones(bshape + (n,), A.dtype)
+    E = jnp.ones(bshape + (m,), A.dtype)
+    Eb = jnp.ones(bshape + (n,), A.dtype)
 
-    def body(_, DE):
-        D, E = DE
-        As = E[:, :, None] * A * D[:, None, :]
+    def body(_, DEE):
+        D, E, Eb = DEE
+        As = E[..., :, None] * A * D[..., None, :]
         Ps = D * P_diag * D
-        cnorm = jnp.maximum(jnp.abs(Ps), jnp.max(jnp.abs(As), axis=1))
-        rnorm = jnp.max(jnp.abs(As), axis=2)
-        d = jnp.where(cnorm < 1e-12, 1.0, 1.0 / jnp.sqrt(jnp.maximum(cnorm, 1e-12)))
-        e = jnp.where(rnorm < 1e-12, 1.0, 1.0 / jnp.sqrt(jnp.maximum(rnorm, 1e-12)))
-        return D * d, E * e
+        g = Eb * D
+        cnorm = jnp.maximum(jnp.maximum(jnp.abs(Ps),
+                                        jnp.max(jnp.abs(As), axis=-2)),
+                            jnp.abs(g))
+        rnorm = jnp.max(jnp.abs(As), axis=-1)
+        d = jnp.where(cnorm < 1e-12, 1.0,
+                      1.0 / jnp.sqrt(jnp.maximum(cnorm, 1e-12)))
+        e = jnp.where(rnorm < 1e-12, 1.0,
+                      1.0 / jnp.sqrt(jnp.maximum(rnorm, 1e-12)))
+        eb = 1.0 / jnp.sqrt(jnp.maximum(jnp.abs(g), 1e-12))
+        return D * d, E * e, Eb * eb
 
-    D, E = jax.lax.fori_loop(0, iters, body, (D, E))
-    return D, E
+    D, E, Eb = jax.lax.fori_loop(0, iters, body, (D, E, Eb))
+    return D, E, Eb
 
 
 def _factorize(factors: QPFactors, rho_scale):
-    """Batched Cholesky of M = diag(P_s) + sigma I + A_s' diag(rho) A_s."""
+    """Cholesky of M = diag(P_s) + sigma I + A_sᵀ diag(ρ_A) A_s + diag(g²ρ_b).
+    Shared mode (A_s (m,n), rho_scale scalar) returns one (n, n) factor."""
     A_s, P_s = factors.A_s, factors.P_s
-    rho = factors.rho_pattern * rho_scale[:, None]
-    n = A_s.shape[2]
-    M = (A_s * rho[:, :, None]).swapaxes(1, 2) @ A_s
+    g = factors.Eb * factors.D
+    n = A_s.shape[-1]
+    if A_s.ndim == 2:
+        rA = factors.rho_A * rho_scale
+        rB = factors.rho_b * rho_scale
+        M = A_s.T @ (rA[:, None] * A_s)
+        M = M + jnp.diag(P_s + factors.sigma + g * g * rB)
+        return jnp.linalg.cholesky(M)
+    rA = factors.rho_A * rho_scale[:, None]
+    rB = factors.rho_b * rho_scale[:, None]
+    M = (A_s * rA[:, :, None]).swapaxes(1, 2) @ A_s
     M = M + jnp.eye(n, dtype=A_s.dtype) * factors.sigma
-    M = M + jax.vmap(jnp.diag)(P_s)
+    M = M + jax.vmap(jnp.diag)(P_s + g * g * rB)
     return jnp.linalg.cholesky(M)
 
 
-@partial(jax.jit, static_argnames=("eq_boost",))
-def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
-    """Equilibrate and scale. O(S n^2) + one batched n^3 Cholesky in
-    qp_cold_state; re-solves with new q reuse everything."""
-    P_diag, A, l, u = data
-    dt = A.dtype
-    D, E = _ruiz_equilibrate(P_diag, A)
-    A_s = E[:, :, None] * A * D[:, None, :]
-    P_s = D * P_diag * D
-    # cost normalization (OSQP sec 5.1): scale so the objective gradient is O(1)
-    if q_ref is None:
-        q_ref = jnp.zeros_like(P_diag)
-    qs = D * q_ref
-    gnorm = jnp.maximum(jnp.max(jnp.abs(P_s), axis=1), jnp.max(jnp.abs(qs), axis=1))
-    cost_scale = 1.0 / jnp.maximum(gnorm, 1.0)
-    P_s = P_s * cost_scale[:, None]
-
-    is_eq = jnp.abs(E * u - E * l) < 1e-12
-    rho_pattern = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
-    return QPFactors(sigma=jnp.asarray(sigma, dt), D=D, E=E,
-                     cost_scale=cost_scale, A_s=A_s, P_s=P_s,
-                     rho_pattern=rho_pattern)
-
-
-@jax.jit
-def qp_cold_state(factors: QPFactors) -> QPState:
-    S, m, n = factors.A_s.shape
-    dt = factors.A_s.dtype
-    rho_scale = jnp.ones((S,), dt)
-    L = _factorize(factors, rho_scale)
-    z = jnp.zeros((S, m), dt)
-    return QPState(x=jnp.zeros((S, n), dt), y=jnp.zeros((S, m), dt), z=z,
-                   L=L, rho_scale=rho_scale, iters=jnp.zeros((), jnp.int32),
-                   pri_res=jnp.full((S,), jnp.inf, dt),
-                   dua_res=jnp.full((S,), jnp.inf, dt),
-                   pri_rel=jnp.full((S,), jnp.inf, dt))
-
-
 def _chol_solve(L, b):
-    """Batched solve M x = b given Cholesky factor L (S,n,n), b (S,n)."""
+    """Solve M x = b given Cholesky factor L; b (S, n). Shared L (n, n)
+    becomes one multi-RHS triangular solve (an (n,n)x(n,S) MXU pass)."""
+    if L.ndim == 2:
+        y = jax.lax.linalg.triangular_solve(L, b.T, left_side=True,
+                                            lower=True, transpose_a=False)
+        x = jax.lax.linalg.triangular_solve(L, y, left_side=True,
+                                            lower=True, transpose_a=True)
+        return x.T
     y = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True,
                                         lower=True, transpose_a=False)
     x = jax.lax.linalg.triangular_solve(L, y, left_side=True,
@@ -162,99 +183,462 @@ def _chol_solve(L, b):
     return x[..., 0]
 
 
-@partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho"))
+@partial(jax.jit, static_argnames=("eq_boost",))
+def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
+    """Equilibrate and scale. Cheap relative to the solve; re-solves with a
+    new q reuse everything. The equality-row rho boost pattern depends only
+    on which rows/columns are pinned (l==u / lb==ub), so one setup serves
+    every PH iteration of a mode."""
+    P_diag, A, l, u, lb, ub = data
+    dt = A.dtype
+    shared = A.ndim == 2
+    D, E, Eb = _ruiz_equilibrate(P_diag, A)
+    A_s = E[..., :, None] * A * D[..., None, :]
+    P_s = D * P_diag * D
+    # cost normalization (OSQP sec 5.1): scale so the objective gradient is O(1)
+    if q_ref is None:
+        q_ref = jnp.zeros(lb.shape, dt)
+    qs = D * q_ref
+    gn_P = jnp.max(jnp.abs(P_s), axis=-1)
+    gn_q = jnp.max(jnp.abs(qs), axis=-1)
+    if shared:
+        gnorm = jnp.maximum(gn_P, jnp.max(gn_q))          # scalar
+        cost_scale = 1.0 / jnp.maximum(gnorm, 1.0)
+        P_s = P_s * cost_scale
+    else:
+        gnorm = jnp.maximum(gn_P, gn_q)                   # (S,)
+        cost_scale = 1.0 / jnp.maximum(gnorm, 1.0)
+        P_s = P_s * cost_scale[:, None]
+
+    def _is_eq(lo, hi):
+        d_ = hi - lo
+        return jnp.isfinite(d_) & (jnp.abs(d_)
+                                   <= 1e-9 * (1.0 + jnp.abs(hi)))
+
+    is_eq = _is_eq(l, u)      # (S, m)
+    is_eq_b = _is_eq(lb, ub)  # (S, n)
+    if shared:
+        # a row must be an equality in EVERY scenario to earn the shared
+        # boost (rho is only a stepsize, so the conservative AND is safe)
+        is_eq = jnp.all(is_eq, axis=0)
+        is_eq_b = jnp.all(is_eq_b, axis=0)
+    rho_A = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
+    rho_b = jnp.where(is_eq_b, rho_base * eq_boost, rho_base).astype(dt)
+    return QPFactors(sigma=jnp.asarray(sigma, dt), D=D, E=E, Eb=Eb,
+                     cost_scale=cost_scale, A_s=A_s, P_s=P_s,
+                     rho_A=rho_A, rho_b=rho_b)
+
+
+@jax.jit
+def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
+    S, m = data.l.shape
+    n = data.lb.shape[-1]
+    dt = factors.A_s.dtype
+    shared = factors.A_s.ndim == 2
+    rho_scale = jnp.ones((), dt) if shared else jnp.ones((S,), dt)
+    L = _factorize(factors, rho_scale)
+    return QPState(x=jnp.zeros((S, n), dt), yA=jnp.zeros((S, m), dt),
+                   yB=jnp.zeros((S, n), dt), zA=jnp.zeros((S, m), dt),
+                   zB=jnp.zeros((S, n), dt), L=L, rho_scale=rho_scale,
+                   iters=jnp.zeros((), jnp.int32),
+                   pri_res=jnp.full((S,), jnp.inf, dt),
+                   dua_res=jnp.full((S,), jnp.inf, dt),
+                   pri_rel=jnp.full((S,), jnp.inf, dt))
+
+
+@partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho",
+                                   "polish", "polish_iters", "polish_chunk"))
 def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
              max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
-             alpha=1.6, adaptive_rho=True):
-    """Run ADMM until residuals pass (eps_abs, eps_rel) or max_iter.
+             alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
+             polish_chunk=0):
+    """Run ADMM until residuals pass (eps_abs, eps_rel) or max_iter, then
+    POLISH: detect the active set from the final slacks, factor the
+    penalty KKT matrix restricted to active rows, and run a few
+    augmented-Lagrangian refinement steps. First-order ADMM stalls on the
+    dual residual for degenerate LPs (y drifts along redundant-constraint
+    null spaces); polishing recovers near-exact primal/dual pairs — which
+    every certified bound in the framework (Ebound, Lagrangian spokes,
+    Benders cuts) consumes — at the cost of a few extra batched Choleskys.
+    Polished results are accepted PER SCENARIO only where they improve
+    max(pri, dua), and the returned duals are the per-scenario argmax of
+    the certified dual objective over all candidates (any dual vector
+    yields a valid bound, so the argmax is valid), so a wrong active-set
+    guess can never degrade a solve.
 
-    Returns (state, x_unscaled (S,n), y_unscaled (S,m)). `q` is the UNscaled
+    The polish factors are per-scenario (S, n, n) even in shared-structure
+    mode (active sets differ per scenario). For large S set
+    ``polish_chunk`` (must divide S) to bound that transient: the polish
+    tail is lax.map'ed over S/polish_chunk chunks.
+
+    Returns (state, x (S,n), yA (S,m), yB (S,n)) — all UNscaled; yA are the
+    constraint-row duals, yB the variable-bound duals. `q` is the unscaled
     linear cost. Warm start by passing the previous state (its adapted rho
-    and factor carry over); cold start with `qp_cold_state(factors)`.
+    and factor carry over); cold start with `qp_cold_state(factors, data)`.
     """
-    sigma, D, E, cs, A_s, P_s, rho_pattern = factors
-    l_s = E * data.l
-    u_s = E * data.u
-    q_s = cs[:, None] * D * q
+    sigma, D, E, Eb, cs, A_s, P_s, rho_A, rho_b = factors
+    shared = A_s.ndim == 2
+    g = Eb * D
+    l_s, u_s = E * data.l, E * data.u
+    lb_s, ub_s = Eb * data.lb, Eb * data.ub
+    csx = cs if shared else cs[:, None]
+    q_s = csx * D * q
     dt = A_s.dtype
     eps_abs = jnp.asarray(eps_abs, dt)
     eps_rel = jnp.asarray(eps_rel, dt)
 
-    def admm_chunk(x, y, z, L, rho):
+    def rho_of(rho_scale):
+        rs = rho_scale if shared else rho_scale[:, None]
+        return rho_A * rs, rho_b * rs
+
+    def admm_chunk(x, yA, yB, zA, zB, L, rA, rB):
         def one(carry, _):
-            x, y, z = carry
-            rhs = sigma * x - q_s + (A_s.swapaxes(1, 2) @ ((rho * z - y)[..., None]))[..., 0]
+            x, yA, yB, zA, zB = carry
+            rhs = sigma * x - q_s + _ATy(A_s, rA * zA - yA) \
+                + g * (rB * zB - yB)
             x_t = _chol_solve(L, rhs)
             x_new = alpha * x_t + (1 - alpha) * x
-            z_t = (A_s @ x_t[..., None])[..., 0]
-            z_mix = alpha * z_t + (1 - alpha) * z
-            z_new = jnp.clip(z_mix + y / rho, l_s, u_s)
-            y_new = y + rho * (z_mix - z_new)
-            return (x_new, y_new, z_new), None
+            zA_t = _Ax(A_s, x_t)
+            zA_mix = alpha * zA_t + (1 - alpha) * zA
+            zA_new = jnp.clip(zA_mix + yA / rA, l_s, u_s)
+            yA_new = yA + rA * (zA_mix - zA_new)
+            zB_t = g * x_t
+            zB_mix = alpha * zB_t + (1 - alpha) * zB
+            zB_new = jnp.clip(zB_mix + yB / rB, lb_s, ub_s)
+            yB_new = yB + rB * (zB_mix - zB_new)
+            return (x_new, yA_new, yB_new, zA_new, zB_new), None
 
-        (x, y, z), _ = jax.lax.scan(one, (x, y, z), None, length=check_every)
-        return x, y, z
+        (x, yA, yB, zA, zB), _ = jax.lax.scan(one, (x, yA, yB, zA, zB), None,
+                                              length=check_every)
+        return x, yA, yB, zA, zB
 
-    def residuals(x, y, z):
-        """UNSCALED residuals (OSQP's default termination convention): the
-        scaled ones can be orders of magnitude smaller than problem-unit
-        errors, which would poison the dual-objective bounds."""
-        Ax = (A_s @ x[..., None])[..., 0]
-        Aty = (A_s.swapaxes(1, 2) @ y[..., None])[..., 0]
-        Einv = 1.0 / E
-        Dinv_c = 1.0 / (D * cs[:, None])
-        pri = jnp.max(jnp.abs(Einv * (Ax - z)), axis=1)
-        dua = jnp.max(jnp.abs(Dinv_c * (P_s * x + q_s + Aty)), axis=1)
-        pri_sc = jnp.maximum(jnp.maximum(jnp.max(jnp.abs(Einv * Ax), axis=1),
-                                         jnp.max(jnp.abs(Einv * z), axis=1)), 1e-6)
-        dua_sc = jnp.maximum(jnp.maximum(
-            jnp.max(jnp.abs(Dinv_c * P_s * x), axis=1),
-            jnp.maximum(jnp.max(jnp.abs(Dinv_c * q_s), axis=1),
-                        jnp.max(jnp.abs(Dinv_c * Aty), axis=1))), 1e-6)
-        return pri, dua, pri_sc, dua_sc
+    def residuals(x, yA, yB, zA, zB):
+        return _unscaled_residuals(A_s, P_s, g, D, E, Eb, csx, q_s,
+                                   x, yA, yB, zA, zB)
 
     def cond(carry):
         *_, it, done = carry
         return jnp.logical_and(it < max_iter, jnp.logical_not(done))
 
     def body(carry):
-        x, y, z, L, rho_scale, it, _ = carry
-        rho = rho_pattern * rho_scale[:, None]
-        x, y, z = admm_chunk(x, y, z, L, rho)
-        pri, dua, pri_sc, dua_sc = residuals(x, y, z)
+        x, yA, yB, zA, zB, L, rho_scale, it, _ = carry
+        rA, rB = rho_of(rho_scale)
+        x, yA, yB, zA, zB = admm_chunk(x, yA, yB, zA, zB, L, rA, rB)
+        pri, dua, pri_sc, dua_sc = residuals(x, yA, yB, zA, zB)
         done = jnp.all(jnp.logical_and(pri <= eps_abs + eps_rel * pri_sc,
                                        dua <= eps_abs + eps_rel * dua_sc))
         if adaptive_rho:
-            # OSQP-style infrequent adaptation: every 4th residual check, and
-            # only scenarios whose ideal rho moved by > 5x adopt the new
-            # value (per-scenario; adapting all on any trigger thrashes)
+            # OSQP-style infrequent adaptation: every 4th residual check;
+            # adopt only when the ideal rho moved by > 5x. In shared mode
+            # the scale is a single scalar (geometric mean of the
+            # per-scenario ideals) so the factor stays shared.
             adapt_now = ((it // check_every) % 4) == 3
-            ratio = jnp.sqrt((pri / pri_sc) / jnp.maximum(dua / dua_sc, 1e-30))
-            new_scale = jnp.clip(rho_scale * ratio, 1e-6, 1e6)
-            change = jnp.maximum(new_scale / rho_scale, rho_scale / new_scale)
-            mask = (change > 5.0) & adapt_now & jnp.logical_not(done)
-            rho_scale = jnp.where(mask, new_scale, rho_scale)
-            need = jnp.any(mask)
+            ratio_s = jnp.sqrt((pri / pri_sc)
+                               / jnp.maximum(dua / dua_sc, 1e-30))
+            if shared:
+                ratio = jnp.exp(jnp.mean(jnp.log(
+                    jnp.clip(ratio_s, 1e-6, 1e6))))
+                new_scale = jnp.clip(rho_scale * ratio, 1e-6, 1e6)
+                change = jnp.maximum(new_scale / rho_scale,
+                                     rho_scale / new_scale)
+                upd = (change > 5.0) & adapt_now & jnp.logical_not(done)
+                rho_scale = jnp.where(upd, new_scale, rho_scale)
+                need = upd
+            else:
+                new_scale = jnp.clip(rho_scale * ratio_s, 1e-6, 1e6)
+                change = jnp.maximum(new_scale / rho_scale,
+                                     rho_scale / new_scale)
+                mask = (change > 5.0) & adapt_now & jnp.logical_not(done)
+                rho_scale = jnp.where(mask, new_scale, rho_scale)
+                need = jnp.any(mask)
             L = jax.lax.cond(need, lambda: _factorize(factors, rho_scale),
                              lambda: L)
-        return (x, y, z, L, rho_scale, it + check_every, done)
+        return (x, yA, yB, zA, zB, L, rho_scale, it + check_every, done)
 
-    x, y, z, L, rho_scale, it, _ = jax.lax.while_loop(
+    x, yA, yB, zA, zB, L, rho_scale, it, _ = jax.lax.while_loop(
         cond, body,
-        (state.x, state.y, state.z, state.L, state.rho_scale,
-         jnp.zeros((), jnp.int32), jnp.array(False)))
+        (state.x, state.yA, state.yB, state.zA, state.zB, state.L,
+         state.rho_scale, jnp.zeros((), jnp.int32), jnp.array(False)))
 
-    pri, dua, pri_sc, _ = residuals(x, y, z)
-    new_state = QPState(x=x, y=y, z=z, L=L, rho_scale=rho_scale, iters=it,
+    pri, dua, pri_sc, dua_sc = residuals(x, yA, yB, zA, zB)
+    # the ADMM iterates are what the NEXT solve warm-starts from (the
+    # polished point sits exactly on the active set — a bad center when the
+    # next q moves it)
+    new_state = QPState(x=x, yA=yA, yB=yB, zA=zA, zB=zB, L=L,
+                        rho_scale=rho_scale, iters=it,
                         pri_res=pri, dua_res=dua, pri_rel=pri / pri_sc)
+
+    if not polish:
+        x_un = D * x
+        yA_un = (1.0 / csx) * E * yA if not shared else (E / cs) * yA
+        yB_un = (1.0 / csx) * Eb * yB if not shared else (Eb / cs) * yB
+        return new_state, x_un, yA_un, yB_un
+
+    # ---- polish tail (chunkable over the scenario axis) ----
+    per = dict(x=x, yA=yA, yB=yB, zA=zA, zB=zB, q_s=q_s,
+               l_s=l_s, u_s=u_s, lb_s=lb_s, ub_s=ub_s,
+               l=data.l, u=data.u, lb=data.lb, ub=data.ub, q=q,
+               pri=pri, dua=dua, pri_sc=pri_sc, dua_sc=dua_sc)
+    if not shared:
+        per.update(A_s=A_s, P_s=P_s, D=D, E=E, Eb=Eb, cs=cs,
+                   Pd=data.P_diag, A_raw=data.A)
+
+    def tail(ps):
+        A_l = ps.get("A_s", A_s)
+        P_l = ps.get("P_s", P_s)
+        D_l = ps.get("D", D)
+        E_l = ps.get("E", E)
+        Eb_l = ps.get("Eb", Eb)
+        cs_l = ps.get("cs", cs)
+        csx_l = cs_l if shared else cs_l[:, None]
+        g_l = Eb_l * D_l
+        # the dual-objective evaluation needs the UNSCALED problem data
+        d_l = QPData(ps.get("Pd", data.P_diag), ps.get("A_raw", data.A),
+                     ps["l"], ps["u"], ps["lb"], ps["ub"])
+        out = _polish_select(
+            A_l, P_l, g_l, D_l, E_l, Eb_l, cs_l, csx_l, sigma, d_l,
+            ps["q"], ps["q_s"], ps["l_s"], ps["u_s"], ps["lb_s"], ps["ub_s"],
+            ps["x"], ps["yA"], ps["yB"], ps["zA"], ps["zB"],
+            ps["pri"], ps["dua"], ps["pri_sc"], ps["dua_sc"],
+            polish_iters, shared)
+        return out
+
+    S = data.l.shape[0]
+    if polish_chunk and 0 < polish_chunk < S and S % polish_chunk == 0:
+        nc = S // polish_chunk
+        resh = lambda a: a.reshape((nc, polish_chunk) + a.shape[1:])
+        unresh = lambda a: a.reshape((S,) + a.shape[2:])
+        out = jax.lax.map(tail, jax.tree.map(resh, per))
+        x_un, yA_un, yB_un, pri, dua, pri_sc = jax.tree.map(unresh, out)
+    else:
+        x_un, yA_un, yB_un, pri, dua, pri_sc = tail(per)
+
+    new_state = new_state._replace(pri_res=pri, dua_res=dua,
+                                   pri_rel=pri / pri_sc)
+    return new_state, x_un, yA_un, yB_un
+
+
+def _unscaled_residuals(A_s, P_s, g, D, E, Eb, csx, q_s, x, yA, yB, zA, zB):
+    """UNSCALED residuals (OSQP's default termination convention): the
+    scaled ones can be orders of magnitude smaller than problem-unit
+    errors, which would poison the dual-objective bounds."""
+    Ax = _Ax(A_s, x)
+    Aty = _ATy(A_s, yA)
+    Einv = 1.0 / E
+    Ebinv = 1.0 / Eb
+    Dinv_c = 1.0 / (D * csx)
+    pri = jnp.maximum(
+        jnp.max(jnp.abs(Einv * (Ax - zA)), axis=1),
+        jnp.max(jnp.abs(D * x - Ebinv * zB), axis=1))
+    dua = jnp.max(jnp.abs(Dinv_c * (P_s * x + q_s + Aty + g * yB)), axis=1)
+    pri_sc = jnp.maximum(jnp.maximum(
+        jnp.maximum(jnp.max(jnp.abs(Einv * Ax), axis=1),
+                    jnp.max(jnp.abs(Einv * zA), axis=1)),
+        jnp.maximum(jnp.max(jnp.abs(D * x), axis=1),
+                    jnp.max(jnp.abs(Ebinv * zB), axis=1))), 1e-6)
+    dua_sc = jnp.maximum(jnp.maximum(
+        jnp.maximum(jnp.max(jnp.abs(Dinv_c * P_s * x), axis=1),
+                    jnp.max(jnp.abs(Dinv_c * q_s), axis=1)),
+        jnp.maximum(jnp.max(jnp.abs(Dinv_c * Aty), axis=1),
+                    jnp.max(jnp.abs(Dinv_c * g * yB), axis=1))), 1e-6)
+    return pri, dua, pri_sc, dua_sc
+
+
+def _polish_select(A_s, P_s, g, D, E, Eb, cs, csx, sigma, data, q, q_s,
+                   l_s, u_s, lb_s, ub_s, x, yA, yB, zA, zB,
+                   pri, dua, pri_sc, dua_sc, polish_iters, shared):
+    """Active-set polish (OSQP sec 5.2, batched) + dual-candidate
+    selection. Three candidates are produced:
+
+      1. proximal AL on the slack-detected active set (exact for
+         non-degenerate scenarios),
+      2. the same after dropping rows whose round-1 dual has the wrong
+         sign (fixes weakly-active misdetection),
+      3. a sign-projected AL (per-iteration projection of each active dual
+         onto its valid orthant) — never catastrophic under degeneracy,
+         merely a little loose.
+
+    The returned x/pri/dua are the best-KKT point among {ADMM, 1, 2}; the
+    returned duals are the per-scenario argmax of the certified dual
+    objective over {ADMM, 1, 2, 3} (any dual vector yields a valid bound,
+    so the argmax is valid)."""
+    dt = A_s.dtype
+    rho_big = jnp.asarray(1e5, dt)
+    S = x.shape[0]
+    A_b = A_s if A_s.ndim == 3 else jnp.broadcast_to(A_s, (S,) + A_s.shape)
+    Pdiag_b = P_s if P_s.ndim == 2 else jnp.broadcast_to(P_s, (S,) + P_s.shape)
+
+    def residuals(x_, yA_, yB_, zA_, zB_):
+        return _unscaled_residuals(A_s, P_s, g, D, E, Eb, csx, q_s,
+                                   x_, yA_, yB_, zA_, zB_)
+
+    # active-set detection tolerance adapts to the achieved primal
+    # accuracy: with pri_rel at the tolerance floor, a fixed 1e-5 cutoff
+    # misclassifies marginal rows and every polish candidate inherits the
+    # bad set
+    act_tol = jnp.maximum(1e-5, 10.0 * (pri / pri_sc))[:, None]
+
+    def act(lo, hi, zv):
+        a_l = jnp.isfinite(lo) & (zv - lo <= act_tol * (1.0 + jnp.abs(lo)))
+        a_u = jnp.isfinite(hi) & (hi - zv <= act_tol * (1.0 + jnp.abs(hi)))
+        b = jnp.where(a_u, jnp.where(jnp.isfinite(hi), hi, 0.0),
+                      jnp.where(a_l, jnp.where(jnp.isfinite(lo), lo, 0.0),
+                                0.0))
+        return a_l | a_u, b
+
+    def penalty_factor(actA, actB):
+        rpA = jnp.where(actA, rho_big, 0.0)
+        rpB = jnp.where(actB, rho_big, 0.0)
+        Mp = jnp.einsum("smi,sm,smj->sij", A_b, rpA, A_b)
+        Mp = Mp + jax.vmap(jnp.diag)(Pdiag_b + sigma + g * g * rpB)
+        Lp = jnp.linalg.cholesky(Mp)
+
+        def apply_Mp(v):
+            return Pdiag_b * v + sigma * v \
+                + _ATy(A_b, rpA * _Ax(A_b, v)) + g * g * rpB * v
+
+        return rpA, rpB, Lp, apply_Mp
+
+    def polish_round(actA, bA, actB, bB, x0):
+        """Proximal augmented-Lagrangian solve on the guessed active set.
+        The per-scenario penalty factor is always batched (active sets
+        differ per scenario): M_p = P + sigma I + A'diag(rpA)A +
+        diag(g^2 rpB). Each inner solve gets two rounds of iterative
+        refinement (the penalty system's conditioning is ~rho_big/sigma;
+        the Cholesky solve alone leaves O(100) stationarity error at
+        problem scale), and sigma*x_prev in the rhs cancels the
+        regularization bias at the fixed point. Duals start from ZERO:
+        stalled ADMM duals carry huge drift components along degenerate
+        dual rays."""
+        rpA, rpB, Lp, apply_Mp = penalty_factor(actA, actB)
+
+        def al_step(carry, _):
+            x_prev, yA_p, yB_p = carry
+            rhs = sigma * x_prev - q_s + _ATy(A_b, rpA * bA - yA_p) \
+                + g * (rpB * bB - yB_p)
+            x_p = _chol_solve(Lp, rhs)
+            x_p = x_p + _chol_solve(Lp, rhs - apply_Mp(x_p))
+            x_p = x_p + _chol_solve(Lp, rhs - apply_Mp(x_p))
+            yA_p = yA_p + rpA * (_Ax(A_b, x_p) - bA)
+            yB_p = yB_p + rpB * (g * x_p - bB)
+            return (x_p, yA_p, yB_p), None
+
+        (x_p, yA_p, yB_p), _ = jax.lax.scan(
+            al_step, (x0, jnp.zeros_like(yA), jnp.zeros_like(yB)),
+            None, length=polish_iters)
+        return x_p, yA_p, yB_p
+
+    def sign_projected_round(alA, auA, eqA, bA, alB, auB, eqB, bB, x0,
+                             iters):
+        """AL with per-iteration dual SIGN PROJECTION (upper-active duals
+        >= 0, lower-active <= 0, equalities free): wrong-sign junk along
+        degenerate dual rays cannot persist, at the cost of slower
+        convergence. Used as a safe dual CANDIDATE."""
+        rpA, rpB, Lp, apply_Mp = penalty_factor(alA | auA, alB | auB)
+
+        def clampy(y, al, au, eq):
+            y = jnp.where(au & ~eq, jnp.maximum(y, 0.0), y)
+            y = jnp.where(al & ~eq, jnp.minimum(y, 0.0), y)
+            return jnp.where(al | au, y, 0.0)
+
+        def step_(carry, _):
+            x_prev, yA_p, yB_p = carry
+            rhs = sigma * x_prev - q_s + _ATy(A_b, rpA * bA - yA_p) \
+                + g * (rpB * bB - yB_p)
+            x_p = _chol_solve(Lp, rhs)
+            x_p = x_p + _chol_solve(Lp, rhs - apply_Mp(x_p))
+            yA_p = clampy(yA_p + rpA * (_Ax(A_b, x_p) - bA), alA, auA, eqA)
+            yB_p = clampy(yB_p + rpB * (g * x_p - bB), alB, auB, eqB)
+            return (x_p, yA_p, yB_p), None
+
+        (x_p, yA_p, yB_p), _ = jax.lax.scan(
+            step_, (x0, jnp.zeros_like(yA), jnp.zeros_like(yB)),
+            None, length=iters)
+        return x_p, yA_p, yB_p
+
+    def accept(x, yA, yB, pri, dua, pri_sc, dua_sc, x_p, yA_p, yB_p):
+        zA_p = jnp.clip(_Ax(A_b, x_p), l_s, u_s)
+        zB_p = jnp.clip(g * x_p, lb_s, ub_s)
+        pri_p, dua_p, pri_sc_p, dua_sc_p = residuals(x_p, yA_p, yB_p,
+                                                     zA_p, zB_p)
+        score = jnp.maximum(pri / pri_sc, dua / dua_sc)
+        score_p = jnp.maximum(pri_p / pri_sc_p, dua_p / dua_sc_p)
+        ok = (score_p < score)[:, None]
+        return (jnp.where(ok, x_p, x), jnp.where(ok, yA_p, yA),
+                jnp.where(ok, yB_p, yB),
+                jnp.where(ok[:, 0], pri_p, pri),
+                jnp.where(ok[:, 0], dua_p, dua),
+                jnp.where(ok[:, 0], pri_sc_p, pri_sc),
+                jnp.where(ok[:, 0], dua_sc_p, dua_sc))
+
+    # round 1: active set from the ADMM slacks
+    actA, bA = act(l_s, u_s, zA)
+    actB, bB = act(lb_s, ub_s, zB)
+    x_p, yA_p, yB_p = polish_round(actA, bA, actB, bB, x)
+    cand1 = (yA_p, yB_p)
+    x, yA, yB, pri, dua, pri_sc, dua_sc = accept(
+        x, yA, yB, pri, dua, pri_sc, dua_sc, x_p, yA_p, yB_p)
+
+    # round 2: re-detect at the polished point and drop rows whose
+    # polished dual has the WRONG SIGN (weakly-active/degenerate rows
+    # wrongly pinned in round 1); equalities are exempt
+    def refilter(lo, hi, zv, yv):
+        a, b = act(lo, hi, zv)
+        eq = jnp.isfinite(hi - lo) & (jnp.abs(hi - lo)
+                                      <= 1e-9 * (1.0 + jnp.abs(hi)))
+        at_u = a & (b == jnp.where(jnp.isfinite(hi), hi, 0.0)) \
+            & (zv >= hi - act_tol * (1.0 + jnp.abs(hi)))
+        wrong = jnp.where(at_u, yv < 0.0, yv > 0.0) & ~eq
+        return a & ~wrong, b
+
+    zA_p = jnp.clip(_Ax(A_b, x_p), l_s, u_s)
+    zB_p = jnp.clip(g * x_p, lb_s, ub_s)
+    actA2, bA2 = refilter(l_s, u_s, zA_p, yA_p)
+    actB2, bB2 = refilter(lb_s, ub_s, zB_p, yB_p)
+    x_p2, yA_p2, yB_p2 = polish_round(actA2, bA2, actB2, bB2, x_p)
+    cand2 = (yA_p2, yB_p2)
+    x, yA, yB, pri, dua, pri_sc, dua_sc = accept(
+        x, yA, yB, pri, dua, pri_sc, dua_sc, x_p2, yA_p2, yB_p2)
+
+    # round 3: sign-projected candidate
+    def act2(lo, hi, zv):
+        a_l = jnp.isfinite(lo) & (zv - lo <= act_tol * (1.0 + jnp.abs(lo)))
+        a_u = jnp.isfinite(hi) & (hi - zv <= act_tol * (1.0 + jnp.abs(hi)))
+        return a_l, a_u, a_l & a_u
+
+    alA, auA, eqA = act2(l_s, u_s, zA)
+    alB, auB, eqB = act2(lb_s, ub_s, zB)
+    _, yA_p3, yB_p3 = sign_projected_round(
+        alA, auA, eqA, bA, alB, auB, eqB, bB, x, 3 * polish_iters)
+    cand3 = (yA_p3, yB_p3)
+
+    def unscale_y(yA_, yB_):
+        yA_u = (1.0 / csx) * E * yA_ if not shared else (E / cs) * yA_
+        yB_u = (1.0 / csx) * Eb * yB_ if not shared else (Eb / cs) * yB_
+        return yA_u, yB_u
+
     x_un = D * x
-    y_un = (1.0 / cs[:, None]) * E * y  # unscale duals
-    return new_state, x_un, y_un
+    yA_un, yB_un = unscale_y(yA, yB)
+    # the certified-bound consumer wants the dual pair with the BEST dual
+    # objective — evaluate every candidate and keep the winner. NaN
+    # candidates (a degenerate active set can break the penalty Cholesky)
+    # must never poison best_val, so it only updates where strictly better.
+    best_val = qp_dual_objective(data, q, 0.0, yA_un, yB_un, x_witness=x_un)
+    best_val = jnp.where(jnp.isnan(best_val), -jnp.inf, best_val)
+    for yA_c, yB_c in (cand1, cand2, cand3):
+        yA_cu, yB_cu = unscale_y(yA_c, yB_c)
+        val = qp_dual_objective(data, q, 0.0, yA_cu, yB_cu, x_witness=x_un)
+        better = (val > best_val)[:, None]
+        yA_un = jnp.where(better, yA_cu, yA_un)
+        yB_un = jnp.where(better, yB_cu, yB_un)
+        best_val = jnp.where(better[:, 0], val, best_val)
+    return x_un, yA_un, yB_un, pri, dua, pri_sc
 
 
 def qp_objective(data: QPData, q, c0, x):
     """½x'Px + q'x + c0 per scenario (unscaled)."""
-    return 0.5 * jnp.sum(data.P_diag * x * x, axis=-1) + jnp.sum(q * x, axis=-1) + c0
+    return 0.5 * jnp.sum(data.P_diag * x * x, axis=-1) \
+        + jnp.sum(q * x, axis=-1) + c0
 
 
 def _boxmin(P, r, lb, ub):
@@ -266,6 +650,14 @@ def _boxmin(P, r, lb, ub):
     lin_lo = jnp.where(r > 0, jnp.where(jnp.isneginf(lb), -jnp.inf, r * lb), 0.0)
     lin_hi = jnp.where(r < 0, jnp.where(jnp.isposinf(ub), -jnp.inf, r * ub), 0.0)
     return jnp.where(P > 0, quad_val, lin_lo + lin_hi)
+
+
+def _sanitize_row_duals(lo, hi, y):
+    """Zero dual components that push on an infinite bound (always
+    sign-infeasible there). Any dual vector gives a valid bound, so this
+    only trades a guaranteed -inf for a finite, witness-penalized term."""
+    y = jnp.where(jnp.isposinf(hi) & (y > 0), 0.0, y)
+    return jnp.where(jnp.isneginf(lo) & (y < 0), 0.0, y)
 
 
 def _sup_rows(l, u, y, inf_tol=1e-9):
@@ -299,31 +691,36 @@ def _column_bound(P, q, r, y_b, lb, ub, x_witness, r_rel_tol):
     contrib_b = _boxmin(P, r - y_b, lb, ub)
     best = jnp.maximum(contrib_a, contrib_b)
     if x_witness is not None:
-        r_fix = jnp.where(jnp.isposinf(ub) & (r_a < 0), 0.0, r_a)
-        r_fix = jnp.where(jnp.isneginf(lb) & (r_fix > 0), 0.0, r_fix)
-        penalty = jnp.abs(r_a - r_fix) * (2.0 * jnp.abs(x_witness) + 1.0)
-        fallback = _boxmin(P, r_fix, lb, ub) - sup_b - penalty
+        def clamped(rv):
+            r_fix = jnp.where(jnp.isposinf(ub) & (rv < 0), 0.0, rv)
+            r_fix = jnp.where(jnp.isneginf(lb) & (r_fix > 0), 0.0, r_fix)
+            penalty = jnp.abs(rv - r_fix) * (2.0 * jnp.abs(x_witness) + 1.0)
+            return _boxmin(P, r_fix, lb, ub) - penalty
+
+        # two fallbacks, mirroring (a) and (b): keeping y_b is useless when
+        # sup_b itself is +inf (a wrong-sign dual pushing on an infinite
+        # bound), so the dropped-y_b clamp must exist independently
+        fallback = jnp.maximum(clamped(r_a) - sup_b, clamped(r - y_b))
         best = jnp.maximum(best, jnp.where(jnp.isneginf(best), fallback, best))
     return best
 
 
-def qp_dual_objective(data: QPData, q, c0, y, n_rows, x_witness=None,
+def qp_dual_objective(data: QPData, q, c0, yA, yB, x_witness=None,
                       r_rel_tol=1e-6):
     """Per-scenario LOWER bound on min ½x'Px + q'x + c0 s.t. l <= Ax <= u,
-    lb <= x <= ub, from an (approximately) dual-feasible y.
+    lb <= x <= ub, from (approximately) dual-feasible (yA, yB).
 
     An inexact *primal* solution over-estimates the subproblem minimum, so
     bounds built from primal objectives (what the reference gets for free
     from its exact MIP solver, ref. phbase.py:314 Ebound) would be invalid
-    here. Instead evaluate a Lagrangian dual at y. With y split into
-    constraint-row duals y_c (first n_rows rows) and folded bound-row duals
-    y_b, *any* choice of bound-row duals yields a valid bound when x is also
-    kept in its box, so per coordinate we take the better of:
+    here. Instead evaluate a Lagrangian dual at y. *Any* choice of
+    bound duals yB yields a valid bound when x is also kept in its box, so
+    per coordinate we take the better of:
 
-      (a) keep y_b_j:  boxmin(½Px² + r_j x) - (ub_j y_bj+ - lb_j y_bj-)
-          with r = q + A'y the full dual residual, entries below
+      (a) keep yB_j:  boxmin(½Px² + r_j x) - (ub_j yB_j+ - lb_j yB_j-)
+          with r = q + AᵀyA + yB the full dual residual, entries below
           r_rel_tol*max(1,|q_j|) zeroed (epsilon-valid convention), and
-      (b) drop y_b_j:  boxmin(½Px² + (r_j - y_bj) x)   [pure reduced cost]
+      (b) drop yB_j:  boxmin(½Px² + (r_j - yB_j) x)   [pure reduced cost]
 
     plus, where both are -inf (an infinite-direction residual above
     tolerance), a witness fallback: clamp the offending residual part and
@@ -331,23 +728,28 @@ def qp_dual_objective(data: QPData, q, c0, y, n_rows, x_witness=None,
     satisfies |x*_j| <= 2|x_witness_j| + 1.
 
     The total is  -sup_c + sum_j best_j + c0  with
-    sup_c = u_c'y_c+ - l_c'y_c- over constraint rows only.
+    sup_c = u'yA+ - l'yA- over the constraint rows.
+
+    Wrong-sign dual components at INFINITE bounds (drift artifacts of a
+    degenerate solve) would make the sup terms +inf and the bound -inf;
+    since any dual vector yields a valid bound, those components are
+    zeroed first — the error moves into r where the per-column machinery
+    absorbs it.
     """
-    lb = data.l[..., n_rows:]
-    ub = data.u[..., n_rows:]
-    y_b = y[..., n_rows:]
-    r = q + (data.A.swapaxes(-1, -2) @ y[..., None])[..., 0]
-    best = _column_bound(data.P_diag, q, r, y_b, lb, ub, x_witness, r_rel_tol)
-    sup_c = _sup_rows(data.l[..., :n_rows], data.u[..., :n_rows],
-                      y[..., :n_rows])
+    yA = _sanitize_row_duals(data.l, data.u, yA)
+    yB = _sanitize_row_duals(data.lb, data.ub, yB)
+    r = q + _ATy(data.A, yA) + yB
+    best = _column_bound(data.P_diag, q, r, yB, data.lb, data.ub,
+                         x_witness, r_rel_tol)
+    sup_c = _sup_rows(data.l, data.u, yA)
     return jnp.sum(best, axis=-1) - sup_c + c0
 
 
-def benders_cut(data: QPData, q, c0, y, n_rows, param_mask, b0,
+def benders_cut(data: QPData, q, c0, yA, yB, param_mask, b0,
                 r_rel_tol=1e-6):
     """Affine minorant of the *value function* V(b) =
     min ½x'Px + q'x + c0 s.t. l <= Ax <= u, box bounds, with the columns in
-    `param_mask` fixed at b (their box rows carry l=u=b in `data`).
+    `param_mask` fixed at b (their boxes carry lb=ub=b in `data`).
 
     Returns (const (S,), g (S, n) zero outside param_mask) such that
     V(b) >= const + g·b[param] for all b, up to the r_rel_tol
@@ -357,31 +759,28 @@ def benders_cut(data: QPData, q, c0, y, n_rows, param_mask, b0,
     from ADMM dual vectors, so inexact subproblem solves still yield
     tolerance-valid cuts).
 
-    Derivation: split the dual y into constraint-row duals y_c (first
-    n_rows) and bound-row duals y_b. Dropping y_b on the parameterized
-    columns, the dual function's dependence on b is
-      sum_{j in param} [ (q + A_c'y_c)_j b_j + ½P_j b_j² ],
+    Derivation: dropping the bound dual yB on the parameterized columns,
+    the dual function's dependence on b is
+      sum_{j in param} [ (q + AᵀyA)_j b_j + ½P_j b_j² ],
     and the quadratic is linearized at b0 (valid: a convex function's
     tangent is a global minorant). Non-parameter columns contribute the
     same per-coordinate best-of-two boxmin terms as qp_dual_objective.
     No x_witness fallback here: its validity box is tied to the solve at
     b0, but a cut must minorize V at EVERY b — a -inf free column simply
     yields an inactive (-inf) cut instead."""
-    lb = data.l[..., n_rows:]
-    ub = data.u[..., n_rows:]
-    y_b = y[..., n_rows:]
     pm = param_mask  # (n,) bool
     P = data.P_diag
 
-    r = q + (data.A.swapaxes(-1, -2) @ y[..., None])[..., 0]
-    r_c = r - y_b  # bound rows are identity, so A_b'y_b = y_b
+    yA = _sanitize_row_duals(data.l, data.u, yA)
+    yB = _sanitize_row_duals(data.lb, data.ub, yB)
+    r = q + _ATy(data.A, yA) + yB
+    r_c = r - yB     # residual without the bound dual
 
     # parameterized columns: affine in b, quadratic linearized at b0
     g = jnp.where(pm, r_c + P * b0, 0.0)
     const_param = jnp.sum(jnp.where(pm, -0.5 * P * b0 * b0, 0.0), axis=-1)
 
-    best = _column_bound(P, q, r, y_b, lb, ub, None, r_rel_tol)
+    best = _column_bound(P, q, r, yB, data.lb, data.ub, None, r_rel_tol)
     const_free = jnp.sum(jnp.where(pm, 0.0, best), axis=-1)
-    sup_c = _sup_rows(data.l[..., :n_rows], data.u[..., :n_rows],
-                      y[..., :n_rows])
+    sup_c = _sup_rows(data.l, data.u, yA)
     return const_param + const_free - sup_c + c0, g

@@ -6,16 +6,16 @@ import pytest
 from scipy.optimize import linprog
 
 from mpisppy_tpu.ops.qp_solver import (
-    QPData, fold_bounds, qp_setup, qp_solve, qp_cold_state, qp_objective)
+    QPData, qp_setup, qp_solve, qp_cold_state, qp_objective)
 
 
 def _solve_batch(P, A, l, u, lb, ub, q, max_iter=20000, **kw):
-    data = fold_bounds(jnp.asarray(P), jnp.asarray(A), jnp.asarray(l),
-                       jnp.asarray(u), jnp.asarray(lb), jnp.asarray(ub))
+    data = QPData(*map(jnp.asarray, (P, A, l, u, lb, ub)))
     factors = qp_setup(data, q_ref=jnp.asarray(q))
-    st = qp_cold_state(factors)
-    st, x, y = qp_solve(factors, data, jnp.asarray(q), st, max_iter=max_iter, **kw)
-    return np.asarray(x), np.asarray(y), st
+    st = qp_cold_state(factors, data)
+    st, x, yA, yB = qp_solve(factors, data, jnp.asarray(q), st,
+                             max_iter=max_iter, **kw)
+    return np.asarray(x), np.asarray(yA), st
 
 
 def test_simple_lp_batch_matches_scipy():
@@ -36,6 +36,29 @@ def test_simple_lp_batch_matches_scipy():
         assert ref.status == 0
         obj = q[s] @ x[s]
         assert obj == pytest.approx(ref.fun, rel=1e-4, abs=1e-4)
+
+
+def test_shared_structure_matches_batched():
+    # same A/P for every scenario, rhs and costs differ: the shared path
+    # (one (n,n) factor) must agree with the batched path
+    rng = np.random.RandomState(7)
+    S, n, m = 5, 6, 4
+    A1 = rng.randn(m, n)
+    A = np.broadcast_to(A1, (S, m, n)).copy()
+    b = rng.rand(S, m) * 5 + 1.0
+    q = rng.randn(S, n)
+    P = np.zeros((S, n))
+    l = np.full((S, m), -np.inf)
+    lb = np.zeros((S, n))
+    ub = np.full((S, n), 10.0)
+
+    x_b, _, _ = _solve_batch(P, A, l, b, lb, ub, q)
+    x_s, _, st = _solve_batch(P[0], A1, l, b, lb, ub, q)
+    assert st.L.ndim == 2  # one shared factor, not (S, n, n)
+    for s in range(S):
+        ref = linprog(q[s], A_ub=A[s], b_ub=b[s], bounds=[(0, 10)] * n)
+        assert q[s] @ x_s[s] == pytest.approx(ref.fun, rel=1e-4, abs=1e-4)
+        assert q[s] @ x_b[s] == pytest.approx(ref.fun, rel=1e-4, abs=1e-4)
 
 
 def test_equality_and_ranged_rows():
@@ -78,15 +101,16 @@ def test_warm_start_reuses_factor():
     ub = np.full((S, n), 5.0)
     q0 = rng.randn(S, n)
 
-    data = fold_bounds(*map(jnp.asarray, (P, A, l, b, lb, ub)))
+    data = QPData(*map(jnp.asarray, (P, A, l, b, lb, ub)))
     factors = qp_setup(data, q_ref=jnp.asarray(q0))
-    st = qp_cold_state(factors)
-    st, x0, _ = qp_solve(factors, data, jnp.asarray(q0), st, max_iter=20000)
+    st = qp_cold_state(factors, data)
+    st, x0, _, _ = qp_solve(factors, data, jnp.asarray(q0), st, max_iter=20000)
     cold_iters = int(st.iters)
 
     # perturb q slightly (PH-like) and re-solve warm: should take fewer iters
     q1 = q0 + 0.01 * rng.randn(S, n)
-    st2, x1, _ = qp_solve(factors, data, jnp.asarray(q1), st, max_iter=20000)
+    st2, x1, _, _ = qp_solve(factors, data, jnp.asarray(q1), st,
+                             max_iter=20000)
     assert int(st2.iters) <= cold_iters
     for s in range(S):
         ref = linprog(q1[s], A_ub=A[s], b_ub=b[s], bounds=[(0, 5)] * n)
@@ -103,8 +127,7 @@ def test_duals_match_scipy():
     l = np.full((1, m), -np.inf)
     lb = np.zeros((1, n))
     ub = np.full((1, n), 5.0)
-    x, y, _ = _solve_batch(P, A, l, b, lb, ub, q, eps_abs=1e-8, eps_rel=1e-8)
+    x, yA, _ = _solve_batch(P, A, l, b, lb, ub, q, eps_abs=1e-8, eps_rel=1e-8)
     ref = linprog(q[0], A_ub=A[0], b_ub=b[0], bounds=[(0, 5)] * n)
-    # scipy HiGHS marginals are negative of our y convention? check magnitude:
-    # our y >= 0 on active upper rows; scipy's ineqlin.marginals are <= 0.
-    assert np.allclose(y[0, :m], -ref.ineqlin.marginals, atol=1e-4)
+    # our yA >= 0 on active upper rows; scipy's ineqlin.marginals are <= 0.
+    assert np.allclose(yA[0], -ref.ineqlin.marginals, atol=1e-4)
