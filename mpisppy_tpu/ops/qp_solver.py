@@ -369,10 +369,7 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
                         pri_res=pri, dua_res=dua, pri_rel=pri / pri_sc)
 
     if not polish:
-        x_un = D * x
-        yA_un = (1.0 / csx) * E * yA if not shared else (E / cs) * yA
-        yB_un = (1.0 / csx) * Eb * yB if not shared else (Eb / cs) * yB
-        return new_state, x_un, yA_un, yB_un
+        return new_state, D * x, (E / csx) * yA, (Eb / csx) * yB
 
     # ---- polish tail (chunkable over the scenario axis) ----
     per = dict(x=x, yA=yA, yB=yB, zA=zA, zB=zB, q_s=q_s,
@@ -404,10 +401,19 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
         return out
 
     S = data.l.shape[0]
-    if polish_chunk and 0 < polish_chunk < S and S % polish_chunk == 0:
-        nc = S // polish_chunk
+    if polish_chunk and 0 < polish_chunk < S:
+        # pad to a chunk multiple with copies of scenario 0 so a
+        # non-dividing chunk size still bounds the (chunk, n, n) transient
+        # instead of silently falling back to the full-batch polish
+        rem = (-S) % polish_chunk
+        Sp = S + rem
+        if rem:
+            per = jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.broadcast_to(a[:1], (rem,) + a.shape[1:])]), per)
+        nc = Sp // polish_chunk
         resh = lambda a: a.reshape((nc, polish_chunk) + a.shape[1:])
-        unresh = lambda a: a.reshape((S,) + a.shape[2:])
+        unresh = lambda a: a.reshape((Sp,) + a.shape[2:])[:S]
         out = jax.lax.map(tail, jax.tree.map(resh, per))
         x_un, yA_un, yB_un, pri, dua, pri_sc = jax.tree.map(unresh, out)
     else:
@@ -613,9 +619,7 @@ def _polish_select(A_s, P_s, g, D, E, Eb, cs, csx, sigma, data, q, q_s,
     cand3 = (yA_p3, yB_p3)
 
     def unscale_y(yA_, yB_):
-        yA_u = (1.0 / csx) * E * yA_ if not shared else (E / cs) * yA_
-        yB_u = (1.0 / csx) * Eb * yB_ if not shared else (Eb / cs) * yB_
-        return yA_u, yB_u
+        return (E / csx) * yA_, (Eb / csx) * yB_
 
     x_un = D * x
     yA_un, yB_un = unscale_y(yA, yB)
