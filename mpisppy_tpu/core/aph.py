@@ -155,9 +155,14 @@ class APH(PHBase):
             self.spcomm = spcomm
         spcomm = self.spcomm   # cylinder layer may have attached one already
         self._ext("pre_iter0")
-        # Iter 0 (ref. phbase Iter0 via aph.py:889): w/prox off
-        self.solve_loop(w_on=False, prox_on=False)
-        self.Update_W()   # W = rho(x - xbar), duals for the first pass
+        # Iter 0 (ref. phbase Iter0 via aph.py:889): w/prox off. Warm-start
+        # semantics match PH.ph_main: a loaded W solves with W on, a loaded
+        # xbar survives iter 0 unoverwritten.
+        warm = getattr(self, "_warm_started", False)
+        warm_xbar = getattr(self, "_warm_started_xbar", False)
+        self.solve_loop(w_on=warm, prox_on=False, update=not warm_xbar)
+        if not warm:
+            self.Update_W()   # W = rho(x - xbar), duals for the first pass
         self.trivial_bound = self.Ebound()
         self.best_bound = self.trivial_bound
         self._iter = 0

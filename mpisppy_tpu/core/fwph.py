@@ -117,11 +117,16 @@ class FWPH(PHBase):
 
     # ---- driver (ref. fwph.py:142-208 fwph_main) ----
     def fwph_main(self, finalize=True):
-        # iter 0: plain solves seed the pool and x̄ (ref. fwph.py:156-168)
-        self.solve_loop(w_on=False, prox_on=False)
+        # iter 0: plain solves seed the pool and x̄ (ref. fwph.py:156-168).
+        # Warm-start semantics match PH.ph_main: a loaded W solves with W
+        # on, a loaded xbar survives iter 0 unoverwritten.
+        warm = getattr(self, "_warm_started", False)
+        warm_xbar = getattr(self, "_warm_started_xbar", False)
+        self.solve_loop(w_on=warm, prox_on=False, update=not warm_xbar)
         self._init_columns(self.x)
         self._xn_t = self.nonants_of(self.x)   # E[xn_t] = x̄ holds at start
-        self.W = self.W_new
+        if not warm:
+            self.Update_W()   # W=0 before, so W = rho(x - xbar)
         self.trivial_bound = self.Ebound()
         self._local_bound = self.trivial_bound
         self._iter = 0

@@ -345,9 +345,15 @@ class PH(PHBase):
         # Iter 0: no W, no prox (ref. phbase.py:1364 Iter0). A warm start
         # (WXBarReader / load_state) keeps the loaded W and solves with it
         # on — the dual bound of that pass is a valid Lagrangian bound since
-        # PH-generated W satisfies sum_s p_s W_s = 0 per node.
+        # PH-generated W satisfies sum_s p_s W_s = 0 per node. An xbar-only
+        # warm start keeps the loaded prox center: iter 0 must not
+        # overwrite it (solve still runs for x/W/bounds).
         warm = getattr(self, "_warm_started", False)
-        self.solve_loop(w_on=warm, prox_on=False)
+        # only an ACTUAL xbar load suppresses the iter-0 xbar update — a
+        # W-only warm start must still compute xbar from the solutions or
+        # iter 1 would prox toward the zeros initialization
+        warm_xbar = getattr(self, "_warm_started_xbar", False)
+        self.solve_loop(w_on=warm, prox_on=False, update=not warm_xbar)
         if not warm:
             self.Update_W()  # W was zero, so W = rho(x - xbar)
         self.trivial_bound = self.Ebound()  # certified wait-and-see bound

@@ -69,15 +69,22 @@ class Hub(SPCommunicator):
 
     def receive_bounds(self):
         """Read every bound spoke's window; freshness via write-id
-        (ref. hub.py:333-354)."""
+        (ref. hub.py:333-354). Only spokes this loop actually CONSUMES
+        advance their last-seen id — a non-bound window (e.g. a cut
+        spoke's, consumed by a subclass) must not be marked read here, or
+        a payload written between the subclass's read and this one is
+        silently lost."""
         for i, sp in enumerate(self.spokes):
+            is_outer = i in self.outer_bound_spoke_indices
+            if not is_outer and i not in self.inner_bound_spoke_indices:
+                continue
             values, wid = sp.my_window.read()
             if wid <= self._spoke_last_ids[i]:
                 continue
             self._spoke_last_ids[i] = wid
-            if i in self.outer_bound_spoke_indices:
+            if is_outer:
                 self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
-            elif i in self.inner_bound_spoke_indices:
+            else:
                 self.InnerBoundUpdate(values[0], sp.converger_spoke_char)
 
     # ---- gap + termination (ref. hub.py:72-137) ----

@@ -105,12 +105,17 @@ class Fixer(Extension):
         newly = (fix_lb | fix_ub | fix_nb) & ~self.fixed_mask[0]
         if not newly.any():
             return
-        value = np.where(fix_lb, self.slot_lb[0],
-                         np.where(fix_ub, self.slot_ub[0], xbar[0]))
+        # per-scenario values: on multistage trees each scenario's xbar row
+        # carries its OWN node's mean (and bounds may differ per scenario),
+        # so fixing must use the full (S, K) arrays — broadcasting row 0
+        # would pin non-root nonants at another node's value, which the
+        # reference never does (it fixes at each variable's node value)
+        value = np.where(fix_lb[None, :], self.slot_lb,
+                         np.where(fix_ub[None, :], self.slot_ub, xbar))
         # integer slots snap to the nearest integer before fixing
         imask = opt.nonant_integer_mask
-        value = np.where(imask, np.round(value), value)
-        self.fixed_vals[:, newly] = value[None, newly]
+        value = np.where(imask[None, :], np.round(value), value)
+        self.fixed_vals[:, newly] = value[:, newly]
         self.fixed_mask[:, newly] = True
         self.nfixed = int(self.fixed_mask[0].sum())
         opt.fix_nonants(self.fixed_vals, mask=self.fixed_mask)

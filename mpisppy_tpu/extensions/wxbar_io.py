@@ -44,45 +44,53 @@ def load_state(opt, path):
         opt.invalidate_factors()
 
 
-def write_w_csv(opt, path):
-    """(scenario, slot, value) rows (ref. wxbarutils.py:40 w_writer)."""
-    W = np.asarray(opt.W)
+def _write_scen_csv(opt, path, arr):
+    """(scenario, slot, value) rows of an (S, K) block."""
     with open(path, "w") as f:
         f.write("scenario,slot,value\n")
         for s, name in enumerate(opt.batch.tree.scen_names):
             for k in range(opt.batch.K):
-                f.write(f"{name},{k},{W[s, k]:.17g}\n")
+                f.write(f"{name},{k},{arr[s, k]:.17g}\n")
+
+
+def _read_scen_csv(opt, path, arr):
+    """Fill an (S, K) array in place from _write_scen_csv output, or from
+    the legacy 2-column (slot, value) format (broadcast to all rows)."""
+    name_to_s = {n: i for i, n in enumerate(opt.batch.tree.scen_names)}
+    with open(path) as f:
+        header = next(f)
+        per_scen = header.strip().startswith("scenario")
+        for line in f:
+            if per_scen:
+                name, k, v = line.rsplit(",", 2)
+                arr[name_to_s[name], int(k)] = float(v)
+            else:
+                k, v = line.split(",")
+                arr[:, int(k)] = float(v)
+    return arr
+
+
+def write_w_csv(opt, path):
+    """(scenario, slot, value) rows (ref. wxbarutils.py:40 w_writer)."""
+    _write_scen_csv(opt, path, np.asarray(opt.W))
 
 
 def read_w_csv(opt, path):
-    W = np.asarray(opt.W).copy()
-    name_to_s = {n: i for i, n in enumerate(opt.batch.tree.scen_names)}
-    with open(path) as f:
-        next(f)
-        for line in f:
-            name, k, v = line.rsplit(",", 2)
-            W[name_to_s[name], int(k)] = float(v)
-    opt.W = jnp.asarray(W, opt.dtype)
+    opt.W = jnp.asarray(_read_scen_csv(opt, path, np.asarray(opt.W).copy()),
+                        opt.dtype)
 
 
 def write_xbar_csv(opt, path):
-    """(slot, value) rows from the root-stage view (ref. wxbarutils.py
-    xbar_writer — xbar is per tree node; scenario 0's row carries them all)."""
-    xbar = np.asarray(opt.xbar)
-    with open(path, "w") as f:
-        f.write("slot,value\n")
-        for k in range(opt.batch.K):
-            f.write(f"{k},{xbar[0, k]:.17g}\n")
+    """(scenario, slot, value) rows — the full (S, K) block. On multistage
+    trees xbar rows differ per node path, so a root-row-only dump would
+    lose every non-root node's mean (ref. wxbarutils.py xbar_writer writes
+    per-node values)."""
+    _write_scen_csv(opt, path, np.asarray(opt.xbar))
 
 
 def read_xbar_csv(opt, path):
-    xbar = np.asarray(opt.xbar).copy()
-    with open(path) as f:
-        next(f)
-        for line in f:
-            k, v = line.split(",")
-            xbar[:, int(k)] = float(v)
-    opt.xbar = jnp.asarray(xbar, opt.dtype)
+    opt.xbar = jnp.asarray(
+        _read_scen_csv(opt, path, np.asarray(opt.xbar).copy()), opt.dtype)
 
 
 class WXBarWriter(Extension):
@@ -127,9 +135,13 @@ class WXBarReader(Extension):
         if self.ckpt_fname and os.path.exists(self.ckpt_fname):
             load_state(opt, self.ckpt_fname)
             opt._warm_started = True
+            opt._warm_started_xbar = True   # ckpt restores xbar too
             return
         if self.w_fname and os.path.exists(self.w_fname):
             read_w_csv(opt, self.w_fname)
             opt._warm_started = True
         if self.x_fname and os.path.exists(self.x_fname):
             read_xbar_csv(opt, self.x_fname)
+            # an xbar-only load must keep iter 0 from overwriting the
+            # loaded prox center, or the warm start is a silent no-op
+            opt._warm_started_xbar = True

@@ -102,3 +102,35 @@ def test_window_protocol():
     assert w.read_id() == 2
     w.kill()
     assert w.read_id() == Window.KILL
+
+
+def test_base_receive_does_not_consume_cut_windows():
+    """A cut payload written between the subclass's read and the base
+    bound loop must NOT be marked consumed (it would be lost forever:
+    the spoke's dedup never resends a round)."""
+    from mpisppy_tpu.core.cross_scenario import CrossScenarioPH
+    from mpisppy_tpu.core.lshaped import LShapedMethod
+    from mpisppy_tpu.cylinders.hub import CrossScenarioHub
+    from mpisppy_tpu.cylinders.cross_scen_spoke import CrossScenarioCutSpoke
+
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 2, "convthresh": -1.0,
+            "subproblem_max_iter": 1500}
+    cph = CrossScenarioPH(_batch(), opts)
+    spoke_opt = LShapedMethod(_batch(), opts)
+    spoke = CrossScenarioCutSpoke(spoke_opt)
+    hub = CrossScenarioHub(cph, spokes=[spoke])
+    hub.make_windows()
+    hub.setup_hub()
+    ci = next(iter(hub.cut_spoke_indices))
+
+    # simulate a cut payload landing in the spoke's window
+    S, K = cph.batch.S, cph.batch.K
+    payload = np.zeros(S * (1 + K))
+    spoke.my_window.put(payload)
+
+    # the BASE bound loop must leave the cut window unread...
+    super(CrossScenarioHub, hub).receive_bounds()
+    assert hub._spoke_last_ids[ci] == 0
+    # ...so the subclass still consumes it
+    hub.receive_bounds()
+    assert hub._spoke_last_ids[ci] > 0

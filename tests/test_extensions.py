@@ -191,3 +191,65 @@ def test_norm_rho_converger_terminates():
     assert ph._iter <= 2
     assert isinstance(ph.converger, NormRhoConverger)
     assert ph.converger.last_norm < 1e3
+
+
+def test_fixer_multistage_fixes_per_scenario_values():
+    """On a multistage tree, xbar rows differ per node path; fixing must
+    pin each scenario at its OWN row's value, not scenario 0's (the
+    reference fixes at each variable's node value)."""
+    from mpisppy_tpu.extensions.fixer import Fixer as _Fixer
+    from mpisppy_tpu.models import hydro
+
+    batch = build_batch(hydro.scenario_creator, hydro.make_tree())
+    fixer = _Fixer({"id_fix_list_fct":
+                    lambda b: uniform_fix_list(b, tol=1e10, nb=1, lb=None,
+                                               ub=None, integer_only=False)})
+    ph = PH(batch, {"defaultPHrho": 1.0, "PHIterLimit": 2,
+                    "convthresh": -1.0, "subproblem_max_iter": 2000},
+            extensions=fixer)
+    ph.ph_main(finalize=False)
+    assert fixer.fixed_mask.any()
+    # stage-2 nonants belong to different nodes per scenario branch: the
+    # fixed values must reproduce each scenario's own xbar row
+    xbar = np.asarray(ph.xbar)
+    k2 = batch.stage_slot_slices[1]
+    fixed2 = fixer.fixed_mask[0, k2]
+    if fixed2.any():
+        vals = fixer.fixed_vals[:, k2][:, fixed2]
+        assert not np.allclose(vals, vals[0:1, :], atol=1e-9) or \
+            np.allclose(xbar[:, k2][:, fixed2], xbar[0:1, k2][:, fixed2])
+
+
+def test_xbar_only_warm_start_is_honored(tmp_path):
+    """An init_Xbar_fname-only warm start must survive iter 0 (it used to
+    be silently overwritten before the first prox solve)."""
+    ph0 = make_ph(iters=0)
+    ph0.ph_main(finalize=False)
+    path = tmp_path / "xbar.csv"
+    # perturb xbar so the loaded values are distinguishable
+    ph0.xbar = ph0.xbar + 7.25
+    wxbar_io.write_xbar_csv(ph0, str(path))
+
+    reader = WXBarReader({"init_Xbar_fname": str(path)})
+    ph1 = make_ph(iters=0, extensions=reader)
+    ph1.ph_main(finalize=False)
+    assert np.allclose(np.asarray(ph1.xbar), np.asarray(ph0.xbar), atol=1e-9)
+
+
+def test_xbar_csv_roundtrips_multistage_rows(tmp_path):
+    """Per-node xbar values survive the CSV round-trip on a 3-stage tree."""
+    from mpisppy_tpu.models import hydro
+    from mpisppy_tpu.core.ph import PHBase
+
+    batch = build_batch(hydro.scenario_creator, hydro.make_tree())
+    ph = PHBase(batch, {"defaultPHrho": 1.0, "subproblem_max_iter": 2000})
+    ph.solve_loop(w_on=False, prox_on=False)
+    xbar0 = np.asarray(ph.xbar).copy()
+    # rows genuinely differ across scenarios at stage 2
+    k2 = batch.stage_slot_slices[1]
+    assert not np.allclose(xbar0[:, k2], xbar0[0:1, k2], atol=1e-9)
+    path = tmp_path / "xbar_ms.csv"
+    wxbar_io.write_xbar_csv(ph, str(path))
+    ph.xbar = ph.xbar * 0.0
+    wxbar_io.read_xbar_csv(ph, str(path))
+    assert np.allclose(np.asarray(ph.xbar), xbar0, atol=1e-12)
