@@ -313,6 +313,36 @@ class PHBase(SPBase):
                  getattr(self, "_last_dual_obj", None))
         self.fix_nonants(self.round_nonants(xhat_vals))
         try:
+            # integer columns OUTSIDE the nonant set (second-stage
+            # integers) need a dive to integral values — the reference
+            # gets this for free from its MIP subproblem solver
+            # (ref. xhatbase.py:117 solves fixed-nonant MIPs)
+            n = self.batch.n
+            nonant_cols = np.zeros(n, bool)
+            nonant_cols[np.asarray(self.batch.nonant_idx)] = True
+            rec_ints = np.asarray(self.batch.integer) & ~nonant_cols
+            if rec_ints.any() and self.options.get("xhat_dive_integers",
+                                                   True):
+                from .mip import dive_integers
+                factors, d0 = self._get_factors(False, fixed=True)
+                idx = self.nonant_idx
+                lb = d0.lb.at[:, idx].set(
+                    jnp.where(self._fixed_mask, self._fixed_vals,
+                              d0.lb[:, idx]))
+                ub = d0.ub.at[:, idx].set(
+                    jnp.where(self._fixed_mask, self._fixed_vals,
+                              d0.ub[:, idx]))
+                d = d0._replace(lb=lb, ub=ub)
+                st = self._ensure_state(False, fixed=True)
+                x, obj, feasible, _ = dive_integers(
+                    factors, d, self.c, self.c0, st, rec_ints,
+                    max_iter=self.sub_max_iter, eps=self.sub_eps,
+                    feas_tol=feas_tol,
+                    polish_chunk=int(self.options.get(
+                        "subproblem_polish_chunk", 0)))
+                if not bool(jnp.all(feasible)):
+                    return None
+                return float(self.Eobjective(obj))
             self.solve_loop(w_on=False, prox_on=False, update=False,
                             fixed=True)
             st = self._qp_states[("fixed", False)]

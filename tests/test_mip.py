@@ -1,0 +1,73 @@
+"""Integer capability: fix-and-dive, host MIP, integer EF parity.
+
+The reference solves every subproblem as a MIP through commercial solvers
+and asserts the sizes 3-scenario EF objective to 2 significant digits
+(ref. mpisppy/tests/test_ef_ph.py:149-150: round_pos_sig(obj, 2) ==
+220000). Here the EF MIP routes through the host HiGHS B&B (the analog of
+the reference's rented solver) and the batched device dive is checked for
+feasibility and a bounded gap against it.
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH
+from mpisppy_tpu.models import sizes, farmer
+
+
+def round_pos_sig(x, sig=2):
+    """ref. mpisppy/tests/test_ef_ph.py round_pos_sig."""
+    import math
+    return round(x, -int(math.floor(math.log10(abs(x)))) + (sig - 1))
+
+
+def _sizes_batch():
+    return build_batch(sizes.scenario_creator, sizes.make_tree(3),
+                       creator_kwargs={"scenario_count": 3})
+
+
+def test_sizes3_integer_ef_matches_reference():
+    """The reference's sizes assertion: EF MIP objective == 220000 to 2
+    significant digits (ref. test_ef_ph.py:149-150)."""
+    ef = ExtensiveForm(_sizes_batch())
+    obj, _ = ef.solve_extensive_form(integer=True, time_limit=90.0)
+    assert round_pos_sig(obj, 2) == 220000
+
+
+def test_sizes3_device_dive_feasible_with_bounded_gap():
+    """The batched on-device dive yields an integer-feasible point whose
+    objective is a VALID upper bound within a few percent of the exact
+    B&B value (its documented quality envelope)."""
+    ef = ExtensiveForm(_sizes_batch())
+    obj_exact, _ = ef.solve_extensive_form(integer=True, time_limit=90.0)
+    ef2 = ExtensiveForm(_sizes_batch())
+    obj_dive, xb = ef2.solve_extensive_form(integer=True,
+                                            integer_method="dive")
+    # the dived point must satisfy the ORIGINAL constraints (the returned
+    # x is integer-snapped, so integrality is checked through residuals,
+    # not through round-tripping the snap)
+    b = ef2.batch
+    for s in range(b.S):
+        Ax = np.asarray(b.A[s]) @ xb[s]
+        scale = 1.0 + np.maximum(
+            np.where(np.isfinite(b.l[s]), np.abs(b.l[s]), 0.0),
+            np.where(np.isfinite(b.u[s]), np.abs(b.u[s]), 0.0))
+        assert (Ax >= b.l[s] - 1e-3 * scale).all()
+        assert (Ax <= b.u[s] + 1e-3 * scale).all()
+    assert obj_dive >= obj_exact - 1.0          # valid upper bound
+    assert obj_dive <= obj_exact * 1.03         # bounded quality gap
+
+
+def test_integer_farmer_incumbent_dive():
+    """Integer farmer (use_integer=True): PH + incumbent evaluation with
+    second-stage dive produces a valid inner bound above the outer."""
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(3),
+                        creator_kwargs={"use_integer": True})
+    ph = PH(batch, {"defaultPHrho": 1.0, "PHIterLimit": 10,
+                    "convthresh": -1.0, "subproblem_max_iter": 2000})
+    ph.ph_main(finalize=False)
+    ub = ph.calculate_incumbent(np.asarray(ph.xbar)[0])
+    assert ub is not None
+    assert ub >= ph.trivial_bound - 1.0
