@@ -1,0 +1,77 @@
+"""Bundling: bundle-EF batches must be equivalent to the unbundled problem.
+
+Mirrors the reference's bundle equivalence tests
+(ref. mpisppy/tests/test_ef_ph.py:262-337): the same optimum through
+bundles, PH over bundles agreeing with unbundled PH, and the bundled
+trivial bound dominating the unbundled one (bundle EFs solve the member
+coupling exactly)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core.bundles import form_bundles, unbundle_x
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH, PHBase
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import farmer
+
+
+def _batch(S=4):
+    return build_batch(farmer.scenario_creator, farmer.make_tree(S))
+
+
+def _opts(**kw):
+    o = {"defaultPHrho": 1.0, "PHIterLimit": 40, "convthresh": 1e-4,
+         "subproblem_max_iter": 3000}
+    o.update(kw)
+    return o
+
+
+def test_bundled_ef_matches_unbundled():
+    batch = _batch(4)
+    obj0, _ = ExtensiveForm(batch).solve_extensive_form()
+    bundled = form_bundles(_batch(4), 2)
+    assert bundled.S == 2 and abs(float(bundled.prob.sum()) - 1.0) < 1e-12
+    obj1, _ = ExtensiveForm(bundled).solve_extensive_form()
+    assert obj1 == pytest.approx(obj0, abs=1.0)
+
+
+def test_bundled_ph_agrees_with_unbundled():
+    batch = _batch(4)
+    ph0 = PH(batch, _opts())
+    ph0.ph_main(finalize=False)
+
+    bundled = form_bundles(_batch(4), 2)
+    ph1 = PH(bundled, _opts())
+    ph1.ph_main(finalize=False)
+
+    # converged first-stage means agree
+    assert np.allclose(np.asarray(ph1.xbar)[0], np.asarray(ph0.xbar)[0],
+                       atol=2.0)
+    # bundling tightens the wait-and-see (trivial) bound:
+    # E_b[min over bundle EF] >= E_s[min over scenario]
+    assert ph1.trivial_bound >= ph0.trivial_bound - 1e-6
+    # and it stays a valid outer bound
+    obj0, _ = ExtensiveForm(_batch(4)).solve_extensive_form()
+    assert ph1.trivial_bound <= obj0 + 1.0
+
+
+def test_unbundle_roundtrip():
+    batch = _batch(4)
+    bundled = form_bundles(_batch(4), 2)
+    ph = PHBase(bundled, _opts())
+    ph.solve_loop(w_on=False, prox_on=False)
+    x = unbundle_x(batch, bundled, np.asarray(ph.x))
+    assert x.shape == (4, batch.n)
+    # members of a bundle share first-stage values
+    idx = np.asarray(batch.nonant_idx)
+    assert np.allclose(x[0, idx], x[1, idx])
+    assert np.allclose(x[2, idx], x[3, idx])
+    # and each scenario's rows are feasible at the unbundled data
+    for s in range(4):
+        Ax = np.asarray(batch.A[s]) @ x[s]
+        scale = 1.0 + np.maximum(
+            np.where(np.isfinite(batch.l[s]), np.abs(batch.l[s]), 0.0),
+            np.where(np.isfinite(batch.u[s]), np.abs(batch.u[s]), 0.0))
+        assert (Ax >= batch.l[s] - 1e-5 * scale).all()
+        assert (Ax <= batch.u[s] + 1e-5 * scale).all()
