@@ -153,7 +153,6 @@ class CrossScenarioPH(PH):
                               jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
         self._factors.clear()
         self._qp_states.clear()
-        self._step_fns.clear()
 
     def update_eta_bounds(self):
         """Tighten the eta lower bounds to the per-scenario wait-and-see
@@ -179,7 +178,6 @@ class CrossScenarioPH(PH):
                               jnp.asarray(lb, t), jnp.asarray(b.ub, t))
         self._factors.clear()
         self._qp_states.clear()
-        self._step_fns.clear()
 
     # ---- EF-bound solve (ref. cross_scen_extension.py:71-117) ----
     def solve_ef_bound(self):

@@ -40,6 +40,54 @@ from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_cold_state,
 from .spbase import SPBase, compute_xbar
 
 
+@partial(jax.jit,
+         static_argnames=("w_on", "prox_on", "slot_slices", "sub_max_iter",
+                          "sub_eps", "polish_chunk"),
+         donate_argnums=(0,))
+def _ph_step(qp_state, factors, data, c, c0, P0, prob, memberships, idx,
+             W, xbar, rho, fixed_mask, fixed_vals, *,
+             w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
+             polish_chunk):
+    """The fused PH iteration: batched subproblem solve + Compute_Xbar +
+    Update_W + convergence + objectives + certified dual bound, one jitted
+    program.
+
+    MODULE-LEVEL on purpose: every engine instance in the process (hub +
+    each spoke cylinder owns its own engine) shares ONE jit cache entry
+    per (mode, shapes) — per-instance closures would recompile the same
+    UC-sized program once per cylinder. Everything large (factors, data,
+    costs) is an ARGUMENT, not a closure constant: closing over batch
+    tensors would bake them into the lowered program as literals
+    (gigabytes at UC scale) and defeat the qp_state buffer donation."""
+    wvec = W - rho * xbar if (w_on and prox_on) else (
+        W if w_on else (-rho * xbar if prox_on else jnp.zeros_like(W)))
+    q = c.at[:, idx].add(wvec)
+    # fixed nonants: pin boxes (ref. phbase.py:413 _fix_nonants)
+    bl = data.lb.at[:, idx].set(
+        jnp.where(fixed_mask, fixed_vals, data.lb[:, idx]))
+    bu = data.ub.at[:, idx].set(
+        jnp.where(fixed_mask, fixed_vals, data.ub[:, idx]))
+    d = data._replace(lb=bl, ub=bu)
+    qp_state, x, yA, yB = qp_solve(factors, d, q, qp_state,
+                                   max_iter=sub_max_iter,
+                                   eps_abs=sub_eps, eps_rel=sub_eps,
+                                   polish_chunk=polish_chunk)
+    xn = x[:, idx]
+    K = xn.shape[1]
+    xbar_new = compute_xbar(memberships, slot_slices, prob, xn)
+    xsqbar_new = compute_xbar(memberships, slot_slices, prob, xn * xn)
+    W_new = W + rho * (xn - xbar_new)
+    conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
+    base_obj = jnp.sum(c * x, axis=1) + c0 \
+        + 0.5 * jnp.sum(P0 * x * x, axis=1)
+    solved_obj = base_obj + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
+    # certified lower bound on each subproblem's optimum (valid for
+    # prox-off solves; see qp_dual_objective)
+    dual_obj = qp_dual_objective(d, q, c0, yA, yB, x_witness=x)
+    return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
+        conv, base_obj, solved_obj, dual_obj
+
+
 class PHBase(SPBase):
     def __init__(self, batch: ScenarioBatch, options=None, rho_setter=None,
                  extensions=None, converger=None, dtype=None, mesh=None):
@@ -84,7 +132,6 @@ class PHBase(SPBase):
         self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
         self._fixed_mask = jnp.zeros((S, K), bool)   # fixer/xhat support
         self._fixed_vals = jnp.zeros((S, K), t)
-        self._step_fns = {}
 
     # ------------- solver plumbing -------------
     def _data_with_prox(self, prox_on: bool) -> QPData:
@@ -159,61 +206,6 @@ class PHBase(SPBase):
         return self._qp_states[key]
 
     # ------------- the fused PH step -------------
-    def _make_step(self, w_on: bool, prox_on: bool, fixed: bool = False):
-        """Build the jitted fused iteration for a (w_on, prox_on) mode.
-
-        Everything large — the factorization artifacts, the constraint
-        data, the cost block — enters as an ARGUMENT, not a closure
-        constant: closing over batch tensors would bake them into the
-        lowered program as literals (gigabytes of constants at UC scale)
-        and defeat buffer donation. Only scalars and the (K,) index vector
-        are captured."""
-        idx = self.nonant_idx
-        K = self.batch.K
-        sub_max_iter, sub_eps = self.sub_max_iter, self.sub_eps
-        sub_polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
-        slot_slices = tuple(self.slot_slices)
-
-        def xbar_of(memberships, prob, xn):
-            return compute_xbar(memberships, slot_slices, prob, xn)
-
-        def step(qp_state, factors, data, c, c0, P0, prob, memberships,
-                 W, xbar, rho, fixed_mask, fixed_vals):
-            wvec = W - rho * xbar if (w_on and prox_on) else (
-                W if w_on else (-rho * xbar if prox_on else jnp.zeros_like(W)))
-            q = c.at[:, idx].add(wvec)
-            # fixed nonants: pin boxes (ref. phbase.py:413 _fix_nonants)
-            bl = data.lb.at[:, idx].set(
-                jnp.where(fixed_mask, fixed_vals, data.lb[:, idx]))
-            bu = data.ub.at[:, idx].set(
-                jnp.where(fixed_mask, fixed_vals, data.ub[:, idx]))
-            d = data._replace(lb=bl, ub=bu)
-            qp_state, x, yA, yB = qp_solve(factors, d, q, qp_state,
-                                           max_iter=sub_max_iter,
-                                           eps_abs=sub_eps, eps_rel=sub_eps,
-                                           polish_chunk=sub_polish_chunk)
-            xn = x[:, idx]
-            xbar_new = xbar_of(memberships, prob, xn)
-            xsqbar_new = xbar_of(memberships, prob, xn * xn)
-            W_new = W + rho * (xn - xbar_new)
-            conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
-            base_obj = jnp.sum(c * x, axis=1) + c0 \
-                + 0.5 * jnp.sum(P0 * x * x, axis=1)
-            solved_obj = base_obj + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
-            # certified lower bound on each subproblem's optimum (valid for
-            # prox-off solves; see qp_dual_objective)
-            dual_obj = qp_dual_objective(d, q, c0, yA, yB, x_witness=x)
-            return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
-                conv, base_obj, solved_obj, dual_obj
-
-        return jax.jit(step, donate_argnums=(0,))
-
-    def _step(self, w_on: bool, prox_on: bool, fixed: bool = False):
-        key = (w_on, prox_on, fixed)
-        if key not in self._step_fns:
-            self._step_fns[key] = self._make_step(w_on, prox_on, fixed)
-        return self._step_fns[key]
-
     def solve_loop(self, w_on=True, prox_on=True, update=True, fixed=False):
         """One batched solve pass in the given mode; mirrors solve_loop
         (ref. phbase.py:999) + Compute_Xbar + Update_W fused. Returns the
@@ -221,13 +213,18 @@ class PHBase(SPBase):
         which is what Ebound of a Lagrangian pass needs). ``fixed=True``
         selects the eq-boosted factorization for fully-pinned solves."""
         qp_state = self._ensure_state(prox_on, fixed)
-        step = self._step(w_on, prox_on, fixed)
         factors, data = self._get_factors(prox_on, fixed)
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
-         base_obj, solved_obj, dual_obj) = step(
+         base_obj, solved_obj, dual_obj) = _ph_step(
             qp_state, factors, data, self.c, self.c0, self.P_diag,
-            self.prob, tuple(self.memberships), self.W, self.xbar,
-            self.rho, self._fixed_mask, self._fixed_vals)
+            self.prob, tuple(self.memberships), self.nonant_idx,
+            self.W, self.xbar, self.rho, self._fixed_mask,
+            self._fixed_vals,
+            w_on=bool(w_on), prox_on=bool(prox_on),
+            slot_slices=tuple(self.slot_slices),
+            sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
+            polish_chunk=int(self.options.get("subproblem_polish_chunk",
+                                              0)))
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB

@@ -29,7 +29,7 @@ class Spoke(SPCommunicator):
     converger_spoke_types = ()
     converger_spoke_char = "?"
 
-    def __init__(self, spbase_object, options=None):
+    def __init__(self, spbase_object, options=None, trace_prefix=None):
         super().__init__(spbase_object, options)
         self.hub_window: Window | None = None   # hub writes, we read
         self.my_window: Window | None = None    # we write, hub reads
@@ -37,6 +37,7 @@ class Spoke(SPCommunicator):
         self._last_kill_check = 0.0
         self.bound = None
         self._trace = []  # (time, bound) pairs (ref. spoke.py:140-153)
+        self._trace_prefix = trace_prefix   # file created by _BoundSpoke
 
     # -- wire protocol (ref. spoke.py:59-99) --
     def spoke_to_hub(self, values):
@@ -92,7 +93,18 @@ class Spoke(SPCommunicator):
 
 class _BoundSpoke(Spoke):
     """Publishes [bound]; CSV-style (time, bound) trace kept in memory and
-    dumpable via ``write_trace`` (ref. spoke.py:135-188 trace_prefix)."""
+    dumpable via ``write_trace``. With ``trace_prefix`` set, a live
+    ``<prefix><SpokeClass>.csv`` is appended on every bound update
+    (ref. spoke.py:135-188 trace_prefix) — only bound spokes write one,
+    so the file lives here, not in the base Spoke."""
+
+    def __init__(self, spbase_object, options=None, trace_prefix=None):
+        super().__init__(spbase_object, options, trace_prefix)
+        self._trace_path = (f"{trace_prefix}{type(self).__name__}.csv"
+                            if trace_prefix else None)
+        if self._trace_path:
+            with open(self._trace_path, "w") as f:
+                f.write("time,bound\n")
 
     def local_window_length(self) -> int:
         return 1
@@ -100,6 +112,9 @@ class _BoundSpoke(Spoke):
     def update_bound(self, value: float):
         self.bound = float(value)
         self._trace.append((time.monotonic(), self.bound))
+        if self._trace_path:
+            with open(self._trace_path, "a") as f:
+                f.write(f"{self._trace[-1][0]},{self.bound}\n")
         self.spoke_to_hub(np.array([self.bound]))
 
     def write_trace(self, path):

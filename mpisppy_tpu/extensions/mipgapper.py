@@ -25,8 +25,7 @@ class Gapper(Extension):
 
     def _apply(self, opt, it):
         if it in self.schedule:
-            opt.sub_eps = self.schedule[it]
-            opt._step_fns.clear()   # eps is baked into the jitted step
+            opt.sub_eps = self.schedule[it]   # static jit arg; next solve recompiles/reuses by eps
             if opt.options.get("verbose"):
                 print(f"Gapper: subproblem_eps = {opt.sub_eps:g} at iter {it}")
 

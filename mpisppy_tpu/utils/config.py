@@ -1,0 +1,124 @@
+"""Typed, validated configuration tree — the baseparsers/PHoptions analog.
+
+The reference stacks three stringly layers with NO unknown-key checking
+(PHoptions dicts + argparse builders + vanilla, ref. utils/baseparsers.py
+:11-451, doc/src/drivers.rst:80-86 "design choice"). SURVEY §5.6 calls for
+one typed validated tree instead; this is it. The three reference roles
+survive as three dataclasses:
+
+  AlgoConfig   — engine options (PHoptions analog, ref. phbase.py:1240
+                 options_check keys)
+  SpokeConfig  — one cylinder beyond the hub (vanilla's *_spoke dicts)
+  RunConfig    — the whole run: model family + algo + hub + spokes
+                 (the drivers' argparse surface, baseparsers.py:11-132)
+
+``RunConfig.validate()`` rejects unknown model names, non-positive
+scenario counts, unknown spoke kinds, and contradictory termination
+settings — errors the reference only surfaces as mid-run KeyErrors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+
+KNOWN_MODELS = ("farmer", "sizes", "sslp", "netdes", "hydro", "uc",
+                "battery")
+KNOWN_SPOKES = ("lagrangian", "lagranger", "xhatshuffle", "xhatlooper",
+                "xhatspecific", "xhatlshaped", "fwph", "slamup",
+                "slamdown", "cross_scenario")
+KNOWN_HUBS = ("ph", "aph", "lshaped")
+
+
+@dataclass
+class AlgoConfig:
+    """Engine options (the PHoptions analog)."""
+    default_rho: float = 1.0
+    max_iterations: int = 100
+    convthresh: float = 1e-4
+    # keep in sync with PHBase's own defaults (core/ph.py) so a CLI run
+    # with no flags matches a programmatic run with no options
+    subproblem_max_iter: int = 5000
+    subproblem_eps: float = 1e-8
+    subproblem_polish_chunk: int = 0
+    linearize_proximal_terms: bool = False   # accepted + ignored (see ph.py)
+    verbose: bool = False
+
+    def to_options(self) -> dict:
+        return {
+            "defaultPHrho": self.default_rho,
+            "PHIterLimit": self.max_iterations,
+            "convthresh": self.convthresh,
+            "subproblem_max_iter": self.subproblem_max_iter,
+            "subproblem_eps": self.subproblem_eps,
+            "subproblem_polish_chunk": self.subproblem_polish_chunk,
+            "verbose": self.verbose,
+        }
+
+    def validate(self):
+        if self.default_rho <= 0:
+            raise ValueError("default_rho must be positive")
+        if self.max_iterations < 0:
+            raise ValueError("max_iterations must be >= 0")
+        if self.subproblem_max_iter <= 0:
+            raise ValueError("subproblem_max_iter must be positive")
+
+
+@dataclass
+class SpokeConfig:
+    """One spoke cylinder (vanilla's *_spoke dict analog,
+    ref. utils/vanilla.py:95-408)."""
+    kind: str
+    options: dict = field(default_factory=dict)
+
+    def validate(self):
+        if self.kind not in KNOWN_SPOKES:
+            raise ValueError(f"unknown spoke kind {self.kind!r}; "
+                             f"known: {KNOWN_SPOKES}")
+
+
+@dataclass
+class RunConfig:
+    """A full cylinder run (the driver-script surface)."""
+    model: str = "farmer"
+    num_scens: int = 3
+    model_kwargs: dict = field(default_factory=dict)
+    num_bundles: int = 0             # 0 = no bundling
+    hub: str = "ph"
+    algo: AlgoConfig = field(default_factory=AlgoConfig)
+    spokes: list = field(default_factory=list)   # list[SpokeConfig]
+    rel_gap: float | None = None
+    abs_gap: float | None = None
+    solve_ef: bool = False           # solve the EF instead of a wheel
+    ef_integer: bool = False
+    trace_prefix: str | None = None
+
+    def validate(self):
+        if self.model not in KNOWN_MODELS:
+            raise ValueError(f"unknown model {self.model!r}; "
+                             f"known: {KNOWN_MODELS}")
+        if self.num_scens <= 0:
+            raise ValueError("num_scens must be positive")
+        if self.hub not in KNOWN_HUBS:
+            raise ValueError(f"unknown hub {self.hub!r}; known: "
+                             f"{KNOWN_HUBS}")
+        if self.num_bundles:
+            if self.num_scens % self.num_bundles != 0:
+                raise ValueError("num_bundles must divide num_scens")
+        if self.rel_gap is not None and not (0 <= self.rel_gap):
+            raise ValueError("rel_gap must be >= 0")
+        if self.abs_gap is not None and not (0 <= self.abs_gap):
+            raise ValueError("abs_gap must be >= 0")
+        self.algo.validate()
+        for sp in self.spokes:
+            sp.validate()
+        if self.hub == "lshaped" and any(
+                sp.kind == "fwph" for sp in self.spokes):
+            raise ValueError("fwph spoke expects a PH-family hub")
+        if self.hub != "ph" and any(
+                sp.kind == "cross_scenario" for sp in self.spokes):
+            raise ValueError("cross_scenario cuts require the 'ph' hub "
+                             "(only CrossScenarioHub consumes cut windows)")
+        return self
+
+    def to_dict(self) -> dict:
+        return asdict(self)

@@ -1,0 +1,105 @@
+"""Config -> hub/spoke construction dicts (the vanilla analog).
+
+Mirrors mpisppy/utils/vanilla.py:30-408: canned factories that turn the
+validated RunConfig into the hub/spoke dict schema spin_the_wheel
+consumes, one factory per cylinder kind. Every cylinder gets its OWN
+engine over its own batch (the reference's cylinders each own an opt
+object the same way, ref. sputils.py:99-108).
+"""
+
+from __future__ import annotations
+
+from .config import RunConfig, SpokeConfig
+
+
+def build_batch_for(cfg: RunConfig):
+    """Model registry: name -> stacked batch (+ bundling)."""
+    from ..ir.batch import build_batch
+    from .. import models
+
+    mod = getattr(models, cfg.model)
+    kwargs = dict(cfg.model_kwargs)
+    if cfg.model == "hydro":
+        tree = mod.make_tree(**kwargs.pop("tree_kwargs", {}))
+    else:
+        tree = mod.make_tree(cfg.num_scens)
+    batch = build_batch(mod.scenario_creator, tree, creator_kwargs=kwargs)
+    if cfg.num_bundles:
+        from ..core.bundles import form_bundles
+        batch = form_bundles(batch, cfg.num_bundles)
+    return batch
+
+
+def hub_dict(cfg: RunConfig):
+    """ref. vanilla.py:54 ph_hub (+ aph/lshaped variants)."""
+    from ..core.ph import PH
+    from ..core.aph import APH
+    from ..core.lshaped import LShapedMethod
+    from ..core.cross_scenario import CrossScenarioPH
+    from ..cylinders.hub import PHHub, APHHub, LShapedHub, CrossScenarioHub
+
+    options = cfg.algo.to_options()
+    hub_kwargs = {"options": {}}
+    if cfg.rel_gap is not None:
+        hub_kwargs["options"]["rel_gap"] = cfg.rel_gap
+    if cfg.abs_gap is not None:
+        hub_kwargs["options"]["abs_gap"] = cfg.abs_gap
+
+    cross = any(sp.kind == "cross_scenario" for sp in cfg.spokes)
+    if cfg.hub == "ph":
+        opt_cls, hub_cls = (CrossScenarioPH, CrossScenarioHub) if cross \
+            else (PH, PHHub)
+    elif cfg.hub == "aph":
+        opt_cls, hub_cls = APH, APHHub
+    else:
+        opt_cls, hub_cls = LShapedMethod, LShapedHub
+    return {"hub_class": hub_cls, "hub_kwargs": hub_kwargs,
+            "opt_class": opt_cls,
+            "opt_kwargs": {"batch": build_batch_for(cfg),
+                           "options": options}}
+
+
+def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
+    """ref. vanilla.py:95-408 — one factory per spoke kind."""
+    from ..core.ph import PHBase
+    from ..core.fwph import FWPH
+    from ..core.lshaped import LShapedMethod
+    from ..cylinders.lagrangian_bounder import (LagrangianOuterBound,
+                                                LagrangerOuterBound)
+    from ..cylinders.xhat_bounders import (XhatLooperInnerBound,
+                                           XhatShuffleInnerBound,
+                                           XhatSpecificInnerBound,
+                                           XhatLShapedInnerBound)
+    from ..cylinders.slam_heuristic import (SlamUpHeuristic,
+                                            SlamDownHeuristic)
+    from ..cylinders.fwph_spoke import FrankWolfeOuterBound
+    from ..cylinders.cross_scen_spoke import CrossScenarioCutSpoke
+
+    classes = {
+        "lagrangian": (LagrangianOuterBound, PHBase),
+        "lagranger": (LagrangerOuterBound, PHBase),
+        "xhatshuffle": (XhatShuffleInnerBound, PHBase),
+        "xhatlooper": (XhatLooperInnerBound, PHBase),
+        "xhatspecific": (XhatSpecificInnerBound, PHBase),
+        "xhatlshaped": (XhatLShapedInnerBound, PHBase),
+        "fwph": (FrankWolfeOuterBound, FWPH),
+        "slamup": (SlamUpHeuristic, PHBase),
+        "slamdown": (SlamDownHeuristic, PHBase),
+        "cross_scenario": (CrossScenarioCutSpoke, LShapedMethod),
+    }
+    spoke_cls, opt_cls = classes[sp.kind]
+    options = cfg.algo.to_options()
+    options.update(sp.options)
+    spoke_kwargs = {}
+    if cfg.trace_prefix:
+        spoke_kwargs["trace_prefix"] = cfg.trace_prefix
+    return {"spoke_class": spoke_cls, "spoke_kwargs": spoke_kwargs,
+            "opt_class": opt_cls,
+            "opt_kwargs": {"batch": build_batch_for(cfg),
+                           "options": options}}
+
+
+def wheel_dicts(cfg: RunConfig):
+    """The full (hub_dict, spoke_dicts) pair for spin_the_wheel."""
+    cfg.validate()
+    return hub_dict(cfg), [spoke_dict(cfg, sp) for sp in cfg.spokes]

@@ -1,0 +1,91 @@
+"""Typed config + CLI driver layer (the baseparsers/vanilla analog).
+
+The reference's driver surface is argparse builders + canned hub/spoke
+dict factories (ref. mpisppy/utils/baseparsers.py:11-451, vanilla.py:
+30-408) exercised by the examples under mpiexec (ref. examples/afew.py).
+Here the CLI wires the same wheel through one validated config tree."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.__main__ import config_from_args, make_parser, run
+from mpisppy_tpu.utils.config import (AlgoConfig, RunConfig, SpokeConfig)
+from mpisppy_tpu.utils.vanilla import wheel_dicts
+
+
+def test_config_validation_rejects_bad_values():
+    with pytest.raises(ValueError):
+        RunConfig(model="nope").validate()
+    with pytest.raises(ValueError):
+        RunConfig(num_scens=0).validate()
+    with pytest.raises(ValueError):
+        RunConfig(hub="simplex").validate()
+    with pytest.raises(ValueError):
+        RunConfig(num_scens=5, num_bundles=2).validate()
+    with pytest.raises(ValueError):
+        RunConfig(spokes=[SpokeConfig(kind="mystery")]).validate()
+    with pytest.raises(ValueError):
+        RunConfig(algo=AlgoConfig(default_rho=-1.0)).validate()
+    with pytest.raises(ValueError):
+        RunConfig(hub="lshaped",
+                  spokes=[SpokeConfig(kind="fwph")]).validate()
+    with pytest.raises(ValueError):
+        RunConfig(hub="aph",
+                  spokes=[SpokeConfig(kind="cross_scenario")]).validate()
+
+
+def test_parser_builds_config():
+    args = make_parser().parse_args(
+        ["farmer", "--num-scens", "4", "--default-rho", "2.5",
+         "--max-iterations", "7", "--with-lagrangian",
+         "--with-xhatshuffle", "--rel-gap", "0.01"])
+    cfg = config_from_args(args)
+    assert cfg.model == "farmer" and cfg.num_scens == 4
+    assert cfg.algo.default_rho == 2.5
+    assert {sp.kind for sp in cfg.spokes} == {"lagrangian", "xhatshuffle"}
+    assert cfg.rel_gap == 0.01
+
+
+def test_wheel_dicts_cover_every_spoke_kind():
+    from mpisppy_tpu.utils.config import KNOWN_SPOKES
+
+    cfg = RunConfig(model="farmer", num_scens=3,
+                    spokes=[SpokeConfig(kind=k) for k in KNOWN_SPOKES])
+    hub_d, spoke_ds = wheel_dicts(cfg)
+    assert "hub_class" in hub_d and "opt_class" in hub_d
+    assert len(spoke_ds) == len(KNOWN_SPOKES)
+    for sd in spoke_ds:
+        assert "spoke_class" in sd and "opt_class" in sd
+    # cross_scenario spoke flips the hub to the cut-aware pair
+    assert hub_d["hub_class"].__name__ == "CrossScenarioHub"
+    assert hub_d["opt_kwargs"]["batch"].S == 3
+
+
+def test_cli_end_to_end_farmer_wheel():
+    """The afew.py analog: a full cylinder run through the CLI entry."""
+    args = make_parser().parse_args(
+        ["farmer", "--num-scens", "3", "--default-rho", "1",
+         "--max-iterations", "20", "--convthresh", "-1",
+         "--subproblem-max-iter", "2000",
+         "--with-lagrangian", "--with-xhatshuffle"])
+    result = run(config_from_args(args))
+    EF3 = -108390.0
+    assert result["outer_bound"] <= EF3 + 2.0
+    assert result["inner_bound"] >= EF3 - 2.0
+
+
+def test_cli_ef_path():
+    args = make_parser().parse_args(["farmer", "--num-scens", "3", "--EF"])
+    result = run(config_from_args(args))
+    assert result["ef_objective"] == pytest.approx(-108390.0, abs=1.0)
+
+
+def test_cli_bundled_run():
+    args = make_parser().parse_args(
+        ["farmer", "--num-scens", "4", "--num-bundles", "2",
+         "--max-iterations", "10", "--convthresh", "-1",
+         "--with-lagrangian"])
+    result = run(config_from_args(args))
+    assert np.isfinite(result["outer_bound"])
