@@ -110,13 +110,11 @@ def run(cfg: RunConfig):
 
 def main(argv=None):
     args = make_parser().parse_args(argv)
-    import jax
-    if not args.f32:
-        jax.config.update("jax_enable_x64", True)
-    # persistent compile cache: repeat CLI invocations skip the seconds-
-    # scale first-compile of the fused step
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from .utils.runtime import setup_jax_runtime
+
+    # x64 + persistent compile cache (shared with process workers so
+    # repeat invocations and spoke children skip the first-compile)
+    setup_jax_runtime(args.f32)
     result = run(config_from_args(args))
     print(json.dumps(result))
     return 0

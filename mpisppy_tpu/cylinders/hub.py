@@ -33,7 +33,9 @@ class Hub(SPCommunicator):
         self.extra_checks = bool((options or {}).get("extra_checks", False))
 
     # ---- topology (ref. hub.py:245-308 + spcommunicator.py:97) ----
-    def make_windows(self):
+    def classify_spokes(self):
+        """Spoke classification by converger_spoke_types
+        (ref. hub.py:245-283 initialize_spoke_indices)."""
         self.outer_bound_spoke_indices = set()
         self.inner_bound_spoke_indices = set()
         self.w_spoke_indices = set()
@@ -48,6 +50,13 @@ class Hub(SPCommunicator):
                 self.w_spoke_indices.add(i)
             if ConvergerSpokeType.NONANT_GETTER in ts:
                 self.nonant_spoke_indices.add(i)
+
+    def make_windows(self):
+        """In-process (thread-cylinder) window wiring; the multi-process
+        path pre-wires SharedWindows on proxies instead
+        (utils/multiproc.py)."""
+        self.classify_spokes()
+        for sp in self.spokes:
             sp.hub_window = Window(sp.remote_window_length())
             sp.my_window = Window(sp.local_window_length())
         self.windows_made = True

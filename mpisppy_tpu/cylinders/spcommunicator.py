@@ -56,6 +56,61 @@ class Window:
         with self.lock:
             return int(self.buf[-1])
 
+    @staticmethod
+    def shared(name: str, length: int, create: bool):
+        """The native C++ shared-memory backend (ops/native/spwindow):
+        same write-id protocol over POSIX shm with a seqlock, for
+        cylinders running as separate PROCESSES (the reference's
+        MPI-RMA star, ref. spcommunicator.py:97-124)."""
+        return SharedWindow(name, length, create)
+
+
+class SharedWindow:
+    """One-writer many-reader shared-memory window (see ops/native)."""
+
+    KILL = -1
+
+    def __init__(self, name: str, length: int, create: bool):
+        from ..ops import native
+
+        self._lib = native.load()
+        self.name = name
+        self.length = int(length)
+        fn = self._lib.spw_create if create else self._lib.spw_open
+        self._h = fn(name.encode(), self.length)
+        if not self._h:
+            raise OSError(f"could not {'create' if create else 'open'} "
+                          f"shared window {name!r}")
+        self._owner = bool(create)
+
+    def put(self, values) -> int:
+        values = np.ascontiguousarray(values, dtype=np.float64).reshape(-1)
+        assert values.shape[0] == self.length, \
+            f"window length {self.length} != payload {values.shape[0]}"
+        import ctypes
+        self._lib.spw_put(self._h, values.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), self.length)
+        return self.read_id()
+
+    def kill(self):
+        self._lib.spw_kill(self._h)
+
+    def read(self):
+        import ctypes
+        out = np.empty(self.length, dtype=np.float64)
+        wid = self._lib.spw_read(self._h, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), self.length)
+        return out, int(wid)
+
+    def read_id(self) -> int:
+        return int(self._lib.spw_read_id(self._h))
+
+    def close(self, unlink=None):
+        if self._h:
+            self._lib.spw_close(self._h, 1 if (self._owner if unlink is None
+                                               else unlink) else 0)
+            self._h = None
+
 
 class SPCommunicator:
     """Base of Hub and Spoke: owns an algorithm (`opt`) instance and the

@@ -1,0 +1,56 @@
+"""Build + ctypes binding for the native shared-memory window backend.
+
+Compiled on demand with g++ (no pybind11 in this image; the C ABI +
+ctypes is all the binding this needs). The .so is cached next to the
+source and rebuilt when the source is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "spwindow.cpp")
+_SO = os.path.join(_HERE, "libspwindow.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _build():
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """Compile (if stale) and load the spwindow library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.spw_create.restype = ctypes.c_void_p
+        lib.spw_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.spw_open.restype = ctypes.c_void_p
+        lib.spw_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.spw_put.restype = None
+        lib.spw_put.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_double),
+                                ctypes.c_int64]
+        lib.spw_read.restype = ctypes.c_int64
+        lib.spw_read.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_double),
+                                 ctypes.c_int64]
+        lib.spw_read_id.restype = ctypes.c_int64
+        lib.spw_read_id.argtypes = [ctypes.c_void_p]
+        lib.spw_kill.restype = None
+        lib.spw_kill.argtypes = [ctypes.c_void_p]
+        lib.spw_close.restype = None
+        lib.spw_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib

@@ -122,3 +122,11 @@ class RunConfig:
 
     def to_dict(self) -> dict:
         return asdict(self)
+
+
+def config_from_dict(d: dict) -> RunConfig:
+    """Inverse of RunConfig.to_dict() (for process workers)."""
+    d = dict(d)
+    d["algo"] = AlgoConfig(**d["algo"])
+    d["spokes"] = [SpokeConfig(**s) for s in d["spokes"]]
+    return RunConfig(**d)

@@ -1,0 +1,173 @@
+"""Multi-process cylinders over the native shared-memory windows.
+
+The reference runs each cylinder as its own MPI process group and wires
+the hub-spoke star with MPI RMA windows (ref. mpisppy/utils/sputils.py:
+133-151 make_comms, cylinders/spcommunicator.py:97-124). Here each spoke
+runs as its own OS process with its own engine (and its own Python/GIL,
+solver state, and — on a multi-chip host — its own device), talking to
+the hub through the native seqlock windows (ops/native/spwindow). The
+write-id/kill protocol is byte-identical to the in-process backend, so
+hub and spoke code runs unchanged.
+
+Resource split: spoke processes default to the CPU backend
+(JAX_PLATFORMS=cpu) so the accelerator stays exclusively the hub's —
+bound evaluation rides host cores, the batched PH iteration rides the
+chip. On a multi-chip host, export per-process device assignments
+instead.
+
+Two-stage of the reference's taxonomy is supported (bound spokes); the
+cross-scenario cut spoke needs the larger cut-window layout and stays
+in-process for now.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import secrets
+import time
+
+from .. import global_toc
+from ..cylinders.spcommunicator import Window
+from ..cylinders.spoke import ConvergerSpokeType
+from .config import RunConfig, config_from_dict
+
+
+class SpokeProxy:
+    """Hub-side stand-in for a spoke living in another process: just the
+    classification surface + the shared window pair."""
+
+    def __init__(self, spoke_cls, S, K, hub_window, my_window):
+        self.converger_spoke_types = spoke_cls.converger_spoke_types
+        self.converger_spoke_char = spoke_cls.converger_spoke_char
+        self._S, self._K = S, K
+        self.hub_window = hub_window
+        self.my_window = my_window
+
+    def hub_read_layout(self):
+        ts = self.converger_spoke_types
+        return (ConvergerSpokeType.W_GETTER in ts,
+                ConvergerSpokeType.NONANT_GETTER in ts)
+
+    def remote_window_length(self) -> int:
+        has_w, has_x = self.hub_read_layout()
+        return self._S * self._K * (int(has_w) + int(has_x))
+
+    def local_window_length(self) -> int:
+        return 1          # bound spokes publish [bound]
+
+
+def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
+    """Runs in the child process: build the engine from the config, wire
+    the shared windows, loop until the hub's kill signal."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from .runtime import setup_jax_runtime
+
+    setup_jax_runtime(f32)
+
+    from .config import SpokeConfig
+    from .vanilla import spoke_dict
+
+    cfg = config_from_dict(cfg_dict)
+    sd = spoke_dict(cfg, SpokeConfig(**spoke_cfg_dict))
+    opt = sd["opt_class"](**sd["opt_kwargs"])
+    spoke = sd["spoke_class"](opt, **sd.get("spoke_kwargs", {}))
+    spoke.hub_window = Window.shared(hub_name,
+                                     spoke.remote_window_length(),
+                                     create=False)
+    spoke.my_window = Window.shared(my_name, spoke.local_window_length(),
+                                    create=False)
+    # startup handshake: a NaN hello tells the hub this spoke is wired and
+    # looping (the reference's window-size Send/Recv handshake analog,
+    # ref. hub.py:285-308). NaN never wins a bound comparison, so the
+    # hub consumes it harmlessly.
+    import numpy as np
+    spoke.my_window.put(np.full(spoke.local_window_length(), np.nan))
+    try:
+        spoke.main()
+        spoke.finalize()
+    finally:
+        spoke.hub_window.close(unlink=False)
+        spoke.my_window.close(unlink=False)
+
+
+def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
+                             spoke_ready_timeout=300.0):
+    """One hub (this process) + one OS process per spoke. Returns the hub
+    after termination; ``hub._spoke_last_ids`` counts consumed updates
+    (>= 1 is the startup hello; > 1 means real bound traffic).
+
+    The hub waits up to ``spoke_ready_timeout`` for every spoke's startup
+    hello before iterating, so a gap-based termination cannot fire before
+    cold-starting spoke processes (JAX init + first compile) have joined
+    the wheel. The spawn context is used so children re-initialize JAX
+    cleanly (a forked JAX runtime is unsupported)."""
+    cfg.validate()
+    for sp in cfg.spokes:
+        if sp.kind == "cross_scenario":
+            raise ValueError("cross_scenario spokes are in-process only "
+                             "for now")
+
+    from .vanilla import hub_dict, spoke_classes
+
+    hub_d = hub_dict(cfg)
+    hub_opt = hub_d["opt_class"](**hub_d["opt_kwargs"])
+    S, K = hub_opt.batch.S, hub_opt.batch.K
+    run_id = f"/spw{os.getpid():x}{secrets.token_hex(4)}"
+
+    ctx = mp.get_context("spawn")
+    proxies, procs, owned = [], [], []
+    try:
+        for i, sp in enumerate(cfg.spokes):
+            spoke_cls, _ = spoke_classes(sp.kind)
+            hub_name = f"{run_id}h{i}"
+            my_name = f"{run_id}s{i}"
+            proxy = SpokeProxy(spoke_cls, S, K, None, None)
+            proxy.hub_window = Window.shared(
+                hub_name, proxy.remote_window_length(), create=True)
+            proxy.my_window = Window.shared(
+                my_name, proxy.local_window_length(), create=True)
+            owned += [proxy.hub_window, proxy.my_window]
+            proxies.append(proxy)
+            from dataclasses import asdict
+            p = ctx.Process(target=_spoke_worker,
+                            args=(cfg.to_dict(), asdict(sp), hub_name,
+                                  my_name, f32), daemon=True)
+            p.start()
+            procs.append(p)
+
+        hub = hub_d["hub_class"](hub_opt, spokes=proxies,
+                                 **hub_d.get("hub_kwargs", {}))
+        hub.classify_spokes()
+        hub.windows_made = True
+        hub.setup_hub()
+        deadline = time.monotonic() + spoke_ready_timeout
+        for i, proxy in enumerate(proxies):
+            while proxy.my_window.read_id() == 0:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"spoke {cfg.spokes[i].kind} (pid {procs[i].pid}) "
+                        "never sent its startup hello")
+                if not procs[i].is_alive():
+                    raise RuntimeError(
+                        f"spoke {cfg.spokes[i].kind} died during startup")
+                time.sleep(0.05)
+        try:
+            hub.main()
+        finally:
+            # a hub failure must still release the spokes (the in-process
+            # wheel guards the same way, utils/sputils.py) — otherwise the
+            # children poll forever on windows the cleanup unlinks
+            hub.send_terminate()
+            for p in procs:
+                p.join(timeout=join_timeout)
+                if p.is_alive():
+                    global_toc(f"multiproc: spoke pid {p.pid} missed the "
+                               "join timeout; terminating")
+                    p.terminate()
+        hub.receive_bounds()
+        hub.hub_finalize()
+        return hub
+    finally:
+        for w in owned:
+            w.close(unlink=True)

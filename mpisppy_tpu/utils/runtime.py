@@ -1,0 +1,19 @@
+"""Process-level JAX runtime setup shared by the CLI and process workers.
+
+One place for the precision policy and the persistent compile cache so
+hub and spoke processes can never silently diverge (the cache is only
+shared when every process configures the same directory).
+"""
+
+from __future__ import annotations
+
+COMPILE_CACHE_DIR = "/tmp/jax_cache"
+
+
+def setup_jax_runtime(f32: bool = False):
+    import jax
+
+    if not f32:
+        jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -59,8 +59,10 @@ def hub_dict(cfg: RunConfig):
                            "options": options}}
 
 
-def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
-    """ref. vanilla.py:95-408 — one factory per spoke kind."""
+def spoke_classes(kind: str):
+    """(spoke_class, opt_class) for a spoke kind — importable without
+    building any batch (the multi-process launcher sizes windows from
+    the class alone)."""
     from ..core.ph import PHBase
     from ..core.fwph import FWPH
     from ..core.lshaped import LShapedMethod
@@ -75,7 +77,7 @@ def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
     from ..cylinders.fwph_spoke import FrankWolfeOuterBound
     from ..cylinders.cross_scen_spoke import CrossScenarioCutSpoke
 
-    classes = {
+    return {
         "lagrangian": (LagrangianOuterBound, PHBase),
         "lagranger": (LagrangerOuterBound, PHBase),
         "xhatshuffle": (XhatShuffleInnerBound, PHBase),
@@ -86,8 +88,12 @@ def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
         "slamup": (SlamUpHeuristic, PHBase),
         "slamdown": (SlamDownHeuristic, PHBase),
         "cross_scenario": (CrossScenarioCutSpoke, LShapedMethod),
-    }
-    spoke_cls, opt_cls = classes[sp.kind]
+    }[kind]
+
+
+def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
+    """ref. vanilla.py:95-408 — one factory per spoke kind."""
+    spoke_cls, opt_cls = spoke_classes(sp.kind)
     options = cfg.algo.to_options()
     options.update(sp.options)
     spoke_kwargs = {}
