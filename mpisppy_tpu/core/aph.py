@@ -161,6 +161,7 @@ class APH(PHBase):
         warm = getattr(self, "_warm_started", False)
         warm_xbar = getattr(self, "_warm_started_xbar", False)
         self.solve_loop(w_on=warm, prox_on=False, update=not warm_xbar)
+        self.assert_feasible_iter0()
         if not warm:
             self.Update_W()   # W = rho(x - xbar), duals for the first pass
         self.trivial_bound = self.Ebound()

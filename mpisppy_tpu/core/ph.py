@@ -132,6 +132,12 @@ class PHBase(SPBase):
         self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
         self._fixed_mask = jnp.zeros((S, K), bool)   # fixer/xhat support
         self._fixed_vals = jnp.zeros((S, K), t)
+        # timing splits (ref. spbase.py:261-269 display_timing, a
+        # secret-menu option there too): wall seconds per solve_loop
+        # call, keyed by mode; off by default (the timing sync would
+        # serialize host work behind device compute)
+        self._timing = bool(opts.get("display_timing", False))
+        self._solve_times = {}
 
     # ------------- solver plumbing -------------
     def _data_with_prox(self, prox_on: bool) -> QPData:
@@ -212,6 +218,8 @@ class PHBase(SPBase):
         per-scenario *solved* objective (including the W term when w_on,
         which is what Ebound of a Lagrangian pass needs). ``fixed=True``
         selects the eq-boosted factorization for fully-pinned solves."""
+        import time as _time
+        t0 = _time.perf_counter()
         qp_state = self._ensure_state(prox_on, fixed)
         factors, data = self._get_factors(prox_on, fixed)
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
@@ -235,8 +243,60 @@ class PHBase(SPBase):
         self._last_base_obj = base_obj
         self._last_solved_obj = solved_obj
         self._last_dual_obj = dual_obj
+        if self._timing:
+            # the sync exists only to time honestly; without the option it
+            # is skipped so host work keeps overlapping device compute
+            jax.block_until_ready(x)
+            self._solve_times.setdefault(
+                (bool(w_on), bool(prox_on), bool(fixed)), []).append(
+                _time.perf_counter() - t0)
         self._ext("post_solve")  # after-each-solve hook (ref. phbase.py:955)
         return solved_obj
+
+    def report_timing(self):
+        """Solve-time splits min/mean/max per mode (ref. spbase.py:261-269
+        display_timing; the reference gathers instance-creation /
+        set-objective / solve times to rank 0 — here the modes play the
+        role of the phases). Returns {mode: (count, min, mean, max)}."""
+        out = {}
+        for key, ts in sorted(self._solve_times.items()):
+            w_on, prox_on, fixed = key
+            name = f"w={int(w_on)} prox={int(prox_on)}" \
+                + (" fixed" if fixed else "")
+            out[name] = (len(ts), min(ts), sum(ts) / len(ts), max(ts))
+        if self.verbose:
+            for name, (n, lo, mean, hi) in out.items():
+                global_toc(f"solve_loop[{name}]: n={n} "
+                           f"min/mean/max = {lo:.3f}/{mean:.3f}/{hi:.3f} s")
+        return out
+
+    def assert_feasible_iter0(self, tol=None):
+        """Abort when any scenario's iter-0 subproblem came out infeasible
+        — the analog of the reference quitting when a scenario is
+        infeasible or probabilities are off at iter 0
+        (ref. phbase.py:1415-1427 _update_E1 / feas_prob abort). Gated by
+        the ``iter0_infeasibility_abort`` option (default on). Like every
+        other feasibility predicate here, a scenario passes on EITHER the
+        absolute or the relative primal residual; the threshold scales
+        with the configured solve tolerance (a converged feasible solve
+        sits at ~sub_eps, an infeasible one orders of magnitude above)."""
+        if not self.options.get("iter0_infeasibility_abort", True):
+            return
+        if tol is None:
+            tol = float(self.options.get("iter0_feas_tol",
+                                         max(1e-3, 100 * self.sub_eps)))
+        st = self._qp_states[False]
+        rel = np.asarray(st.pri_rel)
+        pri = np.asarray(st.pri_res)
+        ok = (pri <= tol) | (rel <= tol)
+        if not np.all(ok):
+            bad = np.flatnonzero(~ok)
+            names = [self.batch.tree.scen_names[i] for i in bad[:5]]
+            raise RuntimeError(
+                f"iter0: {bad.size} scenario subproblem(s) infeasible "
+                f"(pri_rel > {tol:g}), e.g. {names} — aborting like the "
+                "reference's iter-0 infeasibility quit "
+                "(ref. phbase.py:1415-1427)")
 
     # ------------- reference-named primitives -------------
     def Compute_Xbar(self):
@@ -381,6 +441,7 @@ class PH(PHBase):
         # iter 1 would prox toward the zeros initialization
         warm_xbar = getattr(self, "_warm_started_xbar", False)
         self.solve_loop(w_on=warm, prox_on=False, update=not warm_xbar)
+        self.assert_feasible_iter0()
         if not warm:
             self.Update_W()  # W was zero, so W = rho(x - xbar)
         self.trivial_bound = self.Ebound()  # certified wait-and-see bound

@@ -1,0 +1,33 @@
+"""Logging setup (ref. mpisppy/log.py:44-67).
+
+The reference configures a root ``mpisppy`` logger plus per-module file
+logs at CRITICAL default (hub.log, xhatlp.log, ...). Same surface here:
+``setup_logger(name, fname, level)`` attaches a file handler; cylinder
+classes call it when a ``log_prefix`` option is present.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_FORMAT = "%(asctime)s %(name)s %(levelname)s: %(message)s"
+
+root = logging.getLogger("mpisppy_tpu")
+if not root.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter(_FORMAT))
+    root.addHandler(_h)
+    root.setLevel(logging.CRITICAL)   # quiet by default, like the reference
+
+
+def setup_logger(name: str, fname: str | None = None,
+                 level: int = logging.DEBUG) -> logging.Logger:
+    """Per-module logger with an optional file sink
+    (ref. mpisppy/log.py:44 setup_logger)."""
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    if fname is not None:
+        fh = logging.FileHandler(fname)
+        fh.setFormatter(logging.Formatter(_FORMAT))
+        lg.addHandler(fh)
+    return lg

@@ -1,0 +1,86 @@
+"""Aux subsystems: timing splits, iter-0 infeasibility abort, log module,
+live spoke trace files (SURVEY §5.1-5.5)."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core.ph import PH, PHBase
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import farmer
+
+
+def _batch(S=3):
+    return build_batch(farmer.scenario_creator, farmer.make_tree(S))
+
+
+def test_timing_splits_recorded():
+    ph = PH(_batch(), {"defaultPHrho": 1.0, "PHIterLimit": 3,
+                       "convthresh": -1.0, "subproblem_max_iter": 1500,
+                       "display_timing": True})
+    ph.ph_main(finalize=False)
+    rep = ph.report_timing()
+    # iter0 (w=0 prox=0) and the PH iterations (w=1 prox=1)
+    assert "w=0 prox=0" in rep and "w=1 prox=1" in rep
+    n, lo, mean, hi = rep["w=1 prox=1"]
+    assert n == 3 and 0 < lo <= mean <= hi
+
+
+def test_iter0_infeasibility_abort():
+    """An infeasible scenario must abort iter 0 like the reference's quit
+    (ref. phbase.py:1415-1427)."""
+    batch = _batch()
+    # make scenario 1 infeasible: nonnegative-coefficient row forced
+    # negative (farmer row 0 is the land constraint, sum of x_i <= 500)
+    u = np.asarray(batch.u).copy()
+    u[1, 0] = -5.0
+    batch.u = u
+    ph = PH(batch, {"defaultPHrho": 1.0, "PHIterLimit": 2,
+                    "subproblem_max_iter": 1500})
+    with pytest.raises(RuntimeError, match="infeasible"):
+        ph.ph_main(finalize=False)
+    # and the abort is optional, like options-driven behavior elsewhere
+    batch2 = _batch()
+    u = np.asarray(batch2.u).copy()
+    u[1, 0] = -5.0
+    batch2.u = u
+    ph2 = PH(batch2, {"defaultPHrho": 1.0, "PHIterLimit": 1,
+                      "subproblem_max_iter": 200,
+                      "iter0_infeasibility_abort": False})
+    ph2.ph_main(finalize=False)   # runs (garbage but no abort)
+
+
+def test_log_module(tmp_path):
+    from mpisppy_tpu.log import setup_logger
+
+    path = tmp_path / "hub.log"
+    lg = setup_logger("mpisppy_tpu.test_hub", str(path),
+                      level=logging.INFO)
+    lg.info("bound moved to %.2f", -108390.0)
+    for h in lg.handlers:
+        h.flush()
+    assert "bound moved to -108390.00" in path.read_text()
+
+
+def test_spoke_live_trace_file(tmp_path):
+    from mpisppy_tpu.cylinders.hub import PHHub
+    from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
+    from mpisppy_tpu.utils.sputils import spin_the_wheel
+
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 10, "convthresh": -1.0,
+            "subproblem_max_iter": 1500}
+    prefix = str(tmp_path) + "/tr_"
+    spin_the_wheel(
+        {"hub_class": PHHub, "hub_kwargs": {"options": {}},
+         "opt_class": PH, "opt_kwargs": {"batch": _batch(),
+                                         "options": opts}},
+        [{"spoke_class": LagrangianOuterBound,
+          "spoke_kwargs": {"trace_prefix": prefix},
+          "opt_class": PHBase,
+          "opt_kwargs": {"batch": _batch(), "options": opts}}])
+    path = prefix + "LagrangianOuterBound.csv"
+    assert os.path.exists(path)
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "time,bound" and len(lines) >= 2
