@@ -44,8 +44,8 @@ from .spbase import SPBase, compute_xbar
          static_argnames=("w_on", "prox_on", "slot_slices", "sub_max_iter",
                           "sub_eps", "polish_chunk"),
          donate_argnums=(0,))
-def _ph_step(qp_state, factors, data, c, c0, P0, prob, memberships, idx,
-             W, xbar, rho, fixed_mask, fixed_vals, *,
+def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
+             idx, W, xbar, rho, fixed_mask, fixed_vals, *,
              w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
              polish_chunk):
     """The fused PH iteration: batched subproblem solve + Compute_Xbar +
@@ -74,8 +74,8 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, memberships, idx,
                                    polish_chunk=polish_chunk)
     xn = x[:, idx]
     K = xn.shape[1]
-    xbar_new = compute_xbar(memberships, slot_slices, prob, xn)
-    xsqbar_new = compute_xbar(memberships, slot_slices, prob, xn * xn)
+    xbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn)
+    xsqbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn * xn)
     W_new = W + rho * (xn - xbar_new)
     conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
     base_obj = jnp.sum(c * x, axis=1) + c0 \
@@ -90,8 +90,10 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, memberships, idx,
 
 class PHBase(SPBase):
     def __init__(self, batch: ScenarioBatch, options=None, rho_setter=None,
-                 extensions=None, converger=None, dtype=None, mesh=None):
-        super().__init__(batch, options, dtype, mesh=mesh)
+                 extensions=None, converger=None, dtype=None, mesh=None,
+                 variable_probability=False):
+        super().__init__(batch, options, dtype, mesh=mesh,
+                         variable_probability=variable_probability)
         batch = self.batch  # possibly mesh-padded
         opts = self.options
         self.rho_default = float(opts.get("defaultPHrho", 1.0))
@@ -225,9 +227,9 @@ class PHBase(SPBase):
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
          base_obj, solved_obj, dual_obj) = _ph_step(
             qp_state, factors, data, self.c, self.c0, self.P_diag,
-            self.prob, tuple(self.memberships), self.nonant_idx,
-            self.W, self.xbar, self.rho, self._fixed_mask,
-            self._fixed_vals,
+            self.prob, self.xbar_weights, tuple(self.memberships),
+            self.nonant_idx, self.W, self.xbar, self.rho,
+            self._fixed_mask, self._fixed_vals,
             w_on=bool(w_on), prox_on=bool(prox_on),
             slot_slices=tuple(self.slot_slices),
             sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,

@@ -25,21 +25,33 @@ from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import QPData
 
 
-def compute_xbar(memberships, slot_slices, prob, xn):
+def compute_xbar(memberships, slot_slices, weights, xn):
     """Nonanticipative mean per tree node, broadcast back to scenarios.
 
     xn: (S, K) nonant slots. Per non-leaf stage t with membership B_t:
-    xbar = B_t (B_tᵀ(p⊙x) / B_tᵀp) — dense matmuls that become
+    xbar = B_t (B_tᵀ(w⊙x) / B_tᵀw) — dense matmuls that become
     local-matmul + psum when the scenario axis is sharded. This replaces
     the per-node MPI Allreduce in Compute_Xbar (ref. phbase.py:144-221).
-    Free function so jitted steps can take memberships/prob as ARGUMENTS
-    (not baked-in constants); SPBase.compute_xbar wraps it."""
+
+    ``weights`` is the scenario probability vector (S,) — or, with
+    VARIABLE probabilities (ref. spbase.py:369-419 variable_probability:
+    per-variable prob_coeff attached by the scenario creator), an (S, K)
+    block of per-(scenario, slot) weights; the per-node average is then
+    slot-wise weighted. Free function so jitted steps can take
+    memberships/weights as ARGUMENTS (not baked-in constants);
+    SPBase.compute_xbar wraps it."""
     outs = []
     for B, sl in zip(memberships, slot_slices):
         xt = xn[:, sl]
-        pnode = B.T @ prob
-        num = B.T @ (prob[:, None] * xt)
-        outs.append(B @ (num / pnode[:, None]))
+        if weights.ndim == 2:
+            w = weights[:, sl]
+            den = B.T @ w                       # (N, k) per-slot masses
+            num = B.T @ (w * xt)
+            outs.append(B @ (num / den))
+        else:
+            pnode = B.T @ weights
+            num = B.T @ (weights[:, None] * xt)
+            outs.append(B @ (num / pnode[:, None]))
     return jnp.concatenate(outs, axis=1)
 
 
@@ -63,7 +75,34 @@ class SPBase:
         t = self.dtype
         b = batch
         self.prob = jnp.asarray(b.prob, t)
-        if not variable_probability and abs(float(b.prob.sum()) - 1.0) > 1e-6:
+        # variable_probability: False (default) | True (skip the sum
+        # check, reference flag semantics) | an (S, K) array of
+        # per-(scenario, nonant-slot) weights used for the xbar averages
+        # (ref. spbase.py:369-419: per-variable prob_coeff)
+        self.vprob = None
+        if variable_probability is not False and \
+                not isinstance(variable_probability, bool):
+            vp = np.asarray(variable_probability, dtype=np.float64)
+            S_orig = getattr(self, "_S_orig", b.S)
+            if vp.shape == (S_orig, b.K) and S_orig != b.S:
+                # mesh padding added zero-probability scenarios; their
+                # per-variable weights are zero too
+                vp = np.concatenate(
+                    [vp, np.zeros((b.S - S_orig, b.K))], axis=0)
+            if vp.shape != (b.S, b.K):
+                raise ValueError(f"variable_probability must be (S, K) = "
+                                 f"({S_orig}, {b.K}), got {vp.shape}")
+            # every tree NODE needs positive mass on every slot it owns —
+            # a zero per-node denominator would silently NaN the averages
+            for s_, sl in enumerate(b.stage_slot_slices):
+                B = b.tree.membership(s_ + 1)
+                if (B.T @ vp[:, sl] <= 0).any():
+                    raise ValueError(
+                        f"stage {s_ + 1}: some tree node has zero total "
+                        "variable-probability mass on a nonant slot")
+            self.vprob = jnp.asarray(vp, t)
+        elif not variable_probability \
+                and abs(float(b.prob.sum()) - 1.0) > 1e-6:
             raise ValueError("scenario probabilities must sum to 1 "
                              "(ref. spbase.py:443 checks)")
         self.c = jnp.asarray(b.c, t)
@@ -103,6 +142,8 @@ class SPBase:
             repl = lambda a: jax.device_put(
                 a, NamedSharding(mesh, PartitionSpec(*([None] * a.ndim))))
             self.prob = shard(self.prob)
+            if self.vprob is not None:
+                self.vprob = shard(self.vprob)
             self.c = shard(self.c)
             self.c0 = shard(self.c0)
             self.c_stage = shard(self.c_stage)
@@ -126,10 +167,15 @@ class SPBase:
         quad = 0.5 * jnp.sum(self.P_diag * x * x, axis=-1)
         return quad + jnp.sum(self.c * x, axis=-1) + self.c0
 
+    @property
+    def xbar_weights(self):
+        """(S,) scenario probabilities, or (S, K) per-variable weights."""
+        return self.prob if self.vprob is None else self.vprob
+
     def compute_xbar(self, xn):
         """See the module-level compute_xbar (single implementation)."""
-        return compute_xbar(self.memberships, self.slot_slices, self.prob,
-                            xn)
+        return compute_xbar(self.memberships, self.slot_slices,
+                            self.xbar_weights, xn)
 
     def nonants_of(self, x):
         return x[..., self.nonant_idx]

@@ -23,11 +23,18 @@ if not root.handlers:
 def setup_logger(name: str, fname: str | None = None,
                  level: int = logging.DEBUG) -> logging.Logger:
     """Per-module logger with an optional file sink
-    (ref. mpisppy/log.py:44 setup_logger)."""
+    (ref. mpisppy/log.py:44 setup_logger). File-logged records do NOT
+    propagate to the (quiet) console root, and repeat calls for the same
+    name/file don't stack duplicate handlers."""
     lg = logging.getLogger(name)
     lg.setLevel(level)
     if fname is not None:
-        fh = logging.FileHandler(fname)
-        fh.setFormatter(logging.Formatter(_FORMAT))
-        lg.addHandler(fh)
+        lg.propagate = False
+        have = {h.baseFilename for h in lg.handlers
+                if isinstance(h, logging.FileHandler)}
+        import os
+        if os.path.abspath(fname) not in have:
+            fh = logging.FileHandler(fname)
+            fh.setFormatter(logging.Formatter(_FORMAT))
+            lg.addHandler(fh)
     return lg

@@ -5,4 +5,4 @@ Each module provides ``scenario_creator(name, **kwargs) -> Model``,
 ``scenario_denouement`` mirroring the reference's per-example contract.
 """
 
-from . import farmer, hydro, uc, sizes, sslp, netdes, battery  # noqa: F401
+from . import farmer, hydro, uc, sizes, sslp, netdes, battery, ccopf  # noqa: F401

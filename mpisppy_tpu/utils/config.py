@@ -22,7 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field, asdict
 
 KNOWN_MODELS = ("farmer", "sizes", "sslp", "netdes", "hydro", "uc",
-                "battery")
+                "battery", "ccopf")
 KNOWN_SPOKES = ("lagrangian", "lagranger", "xhatshuffle", "xhatlooper",
                 "xhatspecific", "xhatlshaped", "fwph", "slamup",
                 "slamdown", "cross_scenario")

@@ -1,0 +1,152 @@
+"""PySP interop: ingest legacy ScenarioStructure.dat trees.
+
+The reference's PySPModel adapter (ref. mpisppy/utils/pysp_model.py:41)
+consumes PySP 1.0 inputs — an abstract Pyomo model plus a
+``ScenarioStructure.dat`` describing stages, nodes, conditional
+probabilities, and per-stage variables — and produces the
+scenario_creator/names the framework needs. The model half of that
+contract is Pyomo-specific (abstract AMPL-data models); the TPU port
+keeps the reference's boundary by splitting it:
+
+  - ``read_scenario_structure(text)`` parses the ScenarioStructure.dat
+    grammar into this framework's ScenarioTree (stages, node paths,
+    scenario probabilities, per-stage nonant variable names), and
+  - ``PySPModel`` pairs that tree with a scenario_creator callback
+    written against the native Model DSL (the analog of the reference's
+    requirement that the abstract model be instantiable per scenario).
+
+Scenario order follows leaf-node declaration order; the parser reorders
+to node-contiguity when needed (the same guarantee the reference's rank
+map engineers, ref. sputils.py:635-659).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..ir.tree import ScenarioTree
+
+
+def _set_block(text, name):
+    """``set Name := a b c ;`` -> [a, b, c] (None if absent)."""
+    m = re.search(rf"set\s+{re.escape(name)}\s*:=\s*([^;]*);", text)
+    return m.group(1).split() if m else None
+
+
+def _indexed_set_blocks(text, name):
+    """``set Name[idx] := a b ;`` -> {idx: [a, b]}."""
+    out = {}
+    for m in re.finditer(rf"set\s+{re.escape(name)}\s*\[\s*([^\]]+)\s*\]"
+                         rf"\s*:=\s*([^;]*);", text):
+        out[m.group(1).strip()] = m.group(2).split()
+    return out
+def _param_block(text, name):
+    """``param Name := k1 v1 k2 v2 ;`` -> {k1: v1, ...}."""
+    m = re.search(rf"param\s+{re.escape(name)}\s*:=\s*([^;]*);", text)
+    if not m:
+        return {}
+    toks = m.group(1).split()
+    return {toks[i]: toks[i + 1] for i in range(0, len(toks) - 1, 2)}
+
+
+def read_scenario_structure(text: str) -> ScenarioTree:
+    """Parse a PySP ScenarioStructure.dat into a ScenarioTree."""
+    stages = _set_block(text, "Stages")
+    if not stages:
+        raise ValueError("no `set Stages` block found")
+    node_stage = _param_block(text, "NodeStage")
+    children = _indexed_set_blocks(text, "Children")
+    cond_prob = {k: float(v)
+                 for k, v in _param_block(text,
+                                          "ConditionalProbability").items()}
+    scen_leaf = _param_block(text, "ScenarioLeafNode")
+    stage_vars = _indexed_set_blocks(text, "StageVariables")
+
+    if not scen_leaf:
+        raise ValueError("no `param ScenarioLeafNode` block found")
+    parent = {c: p for p, cs in children.items() for c in cs}
+
+    def path_to_root(node):
+        path = [node]
+        while path[-1] in parent:
+            path.append(parent[path[-1]])
+        return path[::-1]            # root .. leaf
+
+    T = len(stages)
+    stage_idx = {s: i for i, s in enumerate(stages)}   # 0-based
+
+    # depth-first leaf order from the root keeps scenarios node-contiguous
+    roots = [n for n, s in node_stage.items() if stage_idx[s] == 0]
+    if len(roots) != 1:
+        raise ValueError(f"expected one root node, found {roots}")
+    order = []
+
+    def dfs(node):
+        kids = children.get(node, [])
+        if not kids:
+            order.append(node)
+        for k in kids:
+            dfs(k)
+
+    dfs(roots[0])
+    leaf_to_scen = {leaf: s for s, leaf in scen_leaf.items()}
+    scen_names = [leaf_to_scen[leaf] for leaf in order
+                  if leaf in leaf_to_scen]
+
+    # per-stage node numbering in dfs-encounter order
+    node_ids = [dict() for _ in range(T - 1)]   # non-leaf stages only
+
+    def number(node):
+        t = stage_idx[node_stage[node]]
+        if t < T - 1 and node not in node_ids[t]:
+            node_ids[t][node] = len(node_ids[t])
+        for k in children.get(node, []):
+            number(k)
+
+    number(roots[0])
+
+    S = len(scen_names)
+    node_paths = np.zeros((S, T - 1), dtype=np.int32)
+    probs = np.zeros(S)
+    for i, name in enumerate(scen_names):
+        path = path_to_root(scen_leaf[name])
+        p = 1.0
+        for node in path:
+            p *= cond_prob.get(node, 1.0)
+            t = stage_idx[node_stage[node]]
+            if t < T - 1:
+                node_paths[i, t] = node_ids[t][node]
+        probs[i] = p
+
+    def clean(names):
+        # DevotedAcreage[*] / QuantitySubQuotaSold -> bare var group name
+        return [re.sub(r"\[.*\]$", "", v) for v in names]
+
+    nonants = [clean(stage_vars.get(s, [])) for s in stages[:-1]]
+    tree = ScenarioTree(scen_names=scen_names, node_paths=node_paths,
+                        nodes_per_stage=[len(d) for d in node_ids],
+                        nonant_names_per_stage=nonants,
+                        probabilities=probs)
+    tree.validate()
+    return tree
+
+
+class PySPModel:
+    """Tree-from-.dat + native-creator pairing (the reference's adapter
+    boundary, ref. utils/pysp_model.py:41: it produces scenario_creator,
+    scenario names and denouement for the rest of the framework)."""
+
+    def __init__(self, scenario_creator, structure_text: str):
+        self.scenario_creator = scenario_creator
+        self.tree = read_scenario_structure(structure_text)
+
+    @property
+    def all_scenario_names(self):
+        return list(self.tree.scen_names)
+
+    def build_batch(self, creator_kwargs=None):
+        from ..ir.batch import build_batch
+        return build_batch(self.scenario_creator, self.tree,
+                           creator_kwargs=creator_kwargs)

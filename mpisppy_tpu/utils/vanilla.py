@@ -19,8 +19,13 @@ def build_batch_for(cfg: RunConfig):
 
     mod = getattr(models, cfg.model)
     kwargs = dict(cfg.model_kwargs)
-    if cfg.model == "hydro":
-        tree = mod.make_tree(**kwargs.pop("tree_kwargs", {}))
+    if cfg.model in ("hydro", "ccopf"):
+        tk = kwargs.pop("tree_kwargs", {})
+        tree = mod.make_tree(**tk)
+        if cfg.model == "ccopf":
+            # the creator decodes scenario numbers with the SAME branching
+            # the tree was built with — they must never diverge
+            kwargs.update(tk)
     else:
         tree = mod.make_tree(cfg.num_scens)
     batch = build_batch(mod.scenario_creator, tree, creator_kwargs=kwargs)

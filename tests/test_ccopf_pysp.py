@@ -1,0 +1,146 @@
+"""4-stage stress model (acopf3 analog) + variable probabilities + PySP
+ScenarioStructure interop (SURVEY L9, §2.6 acopf3 row, spbase.py:369)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.core.ph import PH, PHBase
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.models import ccopf, farmer
+
+
+def _batch():
+    return build_batch(ccopf.scenario_creator, ccopf.make_tree())
+
+
+def test_ccopf_four_stage_ef_and_ph_agree():
+    """EF and converged PH must agree on the 4-stage quadratic model
+    (the hydro-style parity check at acopf3 depth)."""
+    batch = _batch()
+    assert batch.tree.num_stages == 4 and batch.S == 8
+    ef_obj, _ = ExtensiveForm(_batch()).solve_extensive_form()
+
+    ph = PH(_batch(), {"defaultPHrho": 5.0, "PHIterLimit": 120,
+                       "convthresh": 1e-4, "subproblem_max_iter": 3000})
+    conv, eobj, triv = ph.ph_main()
+    assert triv <= ef_obj + abs(ef_obj) * 1e-3   # outer bound
+    assert eobj == pytest.approx(ef_obj, rel=5e-3)
+
+
+def test_ccopf_multistage_xbar_structure():
+    """Stage-2 nonants agree within each stage-2 node but differ across
+    nodes (true multistage nonanticipativity, not an all-scenario mean)."""
+    ph = PHBase(_batch(), {"defaultPHrho": 5.0,
+                           "subproblem_max_iter": 2000})
+    ph.solve_loop(w_on=False, prox_on=False)
+    xbar = np.asarray(ph.xbar)
+    k2 = ph.batch.stage_slot_slices[1]
+    assert np.allclose(xbar[0, k2], xbar[3, k2])       # same stage-2 node
+    assert not np.allclose(xbar[0, k2], xbar[4, k2])   # different node
+
+
+def test_variable_probability_weights_xbar():
+    """(S, K) per-variable weights drive the nonant averages
+    (ref. spbase.py:369-419): zeroing one scenario's weight on a slot
+    makes xbar equal the OTHER scenarios' average there."""
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(3))
+    S, K = batch.S, batch.K
+    vp = np.broadcast_to(np.asarray(batch.prob)[:, None], (S, K)).copy()
+    vp[0, 0] = 0.0          # scenario 0 has no say on slot 0
+    ph = PHBase(batch, {"defaultPHrho": 1.0, "subproblem_max_iter": 2000},
+                variable_probability=vp)
+    ph.solve_loop(w_on=False, prox_on=False)
+    xn = np.asarray(ph.nonants_of(ph.x))
+    xbar = np.asarray(ph.xbar)
+    w = vp[:, 0] / vp[:, 0].sum()
+    assert xbar[0, 0] == pytest.approx(float(w @ xn[:, 0]), rel=1e-6)
+    assert xbar[0, 1] == pytest.approx(
+        float((vp[:, 1] / vp[:, 1].sum()) @ xn[:, 1]), rel=1e-6)
+    # bad shapes / zero-mass slots are rejected up front
+    with pytest.raises(ValueError):
+        PHBase(batch, {}, variable_probability=np.ones((S, K + 1)))
+    vp0 = vp.copy()
+    vp0[:, 2] = 0.0
+    with pytest.raises(ValueError):
+        PHBase(batch, {}, variable_probability=vp0)
+
+
+FARMER_DAT = """
+set Stages := FirstStage SecondStage ;
+set Nodes := RootNode BelowAverageNode AverageNode AboveAverageNode ;
+param NodeStage := RootNode FirstStage
+                   BelowAverageNode SecondStage
+                   AverageNode SecondStage
+                   AboveAverageNode SecondStage ;
+set Children[RootNode] := BelowAverageNode AverageNode AboveAverageNode ;
+param ConditionalProbability := RootNode 1.0
+                                BelowAverageNode 0.33333333
+                                AverageNode 0.33333334
+                                AboveAverageNode 0.33333333 ;
+set Scenarios := BelowAverageScenario AverageScenario AboveAverageScenario ;
+param ScenarioLeafNode := BelowAverageScenario BelowAverageNode
+                          AverageScenario AverageNode
+                          AboveAverageScenario AboveAverageNode ;
+set StageVariables[FirstStage] := DevotedAcreage[*] ;
+set StageVariables[SecondStage] := QuantitySubQuotaSold[*] ;
+param StageCost := FirstStage FirstStageCost SecondStage SecondStageCost ;
+"""
+
+THREE_STAGE_DAT = """
+set Stages := S1 S2 S3 ;
+set Nodes := R N1 N2 L11 L12 L21 L22 ;
+param NodeStage := R S1 N1 S2 N2 S2 L11 S3 L12 S3 L21 S3 L22 S3 ;
+set Children[R] := N1 N2 ;
+set Children[N1] := L11 L12 ;
+set Children[N2] := L21 L22 ;
+param ConditionalProbability := R 1.0 N1 0.4 N2 0.6
+                                L11 0.5 L12 0.5 L21 0.25 L22 0.75 ;
+set Scenarios := Sc1 Sc2 Sc3 Sc4 ;
+param ScenarioLeafNode := Sc1 L11 Sc2 L12 Sc3 L21 Sc4 L22 ;
+set StageVariables[S1] := X[*] ;
+set StageVariables[S2] := Y[*] ;
+"""
+
+
+def test_pysp_two_stage_structure():
+    from mpisppy_tpu.utils.pysp_model import read_scenario_structure
+
+    tree = read_scenario_structure(FARMER_DAT)
+    assert tree.num_stages == 2 and tree.S == 3
+    assert tree.scen_names == ["BelowAverageScenario", "AverageScenario",
+                               "AboveAverageScenario"]
+    assert abs(tree.probabilities.sum() - 1.0) < 1e-6
+    assert tree.nonant_names_per_stage == [["DevotedAcreage"]]
+
+
+def test_pysp_three_stage_structure_and_batch():
+    from mpisppy_tpu.utils.pysp_model import (PySPModel,
+                                              read_scenario_structure)
+
+    tree = read_scenario_structure(THREE_STAGE_DAT)
+    assert tree.num_stages == 3
+    assert tree.nodes_per_stage == [1, 2]
+    assert np.allclose(sorted(tree.probabilities),
+                       sorted([0.2, 0.2, 0.15, 0.45]))
+    assert (tree.node_path[:2, 1] == tree.node_path[0, 1]).all()
+
+    # pairing with a native creator produces a workable batch
+    from mpisppy_tpu.ir.model import Model
+
+    def creator(name, **_):
+        m = Model(name, sense="min")
+        x = m.var("X", 2, lb=0.0, ub=10.0, stage=1)
+        y = m.var("Y", 1, lb=0.0, ub=10.0, stage=2)
+        z = m.var("Z", 1, lb=0.0, ub=10.0, stage=3)
+        m.constr(x.sum() + y + z >= 4.0, name="cover")
+        m.stage_cost(1, x.dot(np.array([1.0, 2.0])))
+        m.stage_cost(2, 3.0 * y.sum())
+        m.stage_cost(3, 0.5 * z.sum())
+        return m
+
+    pysp = PySPModel(creator, THREE_STAGE_DAT)
+    batch = pysp.build_batch()
+    assert batch.S == 4 and batch.tree.num_stages == 3
+    ef_obj, _ = ExtensiveForm(batch).solve_extensive_form()
+    assert np.isfinite(ef_obj)
