@@ -93,7 +93,7 @@ class ExtensiveForm(SPBase):
 
     def solve_extensive_form(self, max_iter=40000, eps_abs=1e-7, eps_rel=1e-7,
                              integer=False, integer_method="milp",
-                             time_limit=120.0):
+                             time_limit=120.0, mip_gap=None):
         """Solve the EF; mirrors opt/ef.py:61. Returns (objective, x_batch)
         where x_batch is the per-scenario (S, n) solution block.
 
@@ -123,7 +123,7 @@ class ExtensiveForm(SPBase):
                 from .mip import milp_solve
                 x_int, _, feasible = milp_solve(
                     self.ef_data, self.c_ef, self.c0_ef, integer_ef,
-                    time_limit=time_limit)
+                    time_limit=time_limit, mip_gap=mip_gap)
                 x_int = jnp.asarray(x_int, self.dtype)
             else:
                 from .mip import dive_integers

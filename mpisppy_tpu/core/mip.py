@@ -104,9 +104,18 @@ def _dive_once(factors, data, q, state, imask, round_offset,
                 pin[s, take] = True
                 v = val_bias[s, take]
                 if flip[s]:
-                    vn = val_near[s, take]
-                    v = np.where(v > vn - 0.25, v - 1.0, v + 1.0)
-                    v = np.clip(v, lb0[s, take], ub0[s, take])
+                    # the other integer neighbour of the fractional value:
+                    # a value that was rounded down flips up and vice versa
+                    # (flipping relative to val_near would no-op at a bound,
+                    # e.g. a 0-pinned binary clipping right back to 0); when
+                    # the preferred neighbour leaves the box (a loose solve
+                    # can leave x outside it), go the other way
+                    lo, hi = lb0[s, take], ub0[s, take]
+                    xr = np.clip(x_h[s, take], lo, hi)
+                    v_alt = np.where(v <= xr, v + 1.0, v - 1.0)
+                    v_alt = np.where(v_alt > hi, v - 1.0,
+                                     np.where(v_alt < lo, v + 1.0, v_alt))
+                    v = np.clip(v_alt, lo, hi)
                 val[s, take] = v
             lb_t, ub_t = lb.copy(), ub.copy()
             lb_t[pin] = val[pin]
