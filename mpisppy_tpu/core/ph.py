@@ -35,48 +35,54 @@ import numpy as np
 
 from .. import global_toc
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_cold_state,
+from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_solve_mixed,
+                             qp_solve_segmented, qp_cold_state,
                              qp_dual_objective)
 from .spbase import SPBase, compute_xbar
 
 
-@partial(jax.jit,
-         static_argnames=("w_on", "prox_on", "slot_slices", "sub_max_iter",
-                          "sub_eps", "polish_chunk"),
-         donate_argnums=(0,))
-def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
-             idx, W, xbar, rho, fixed_mask, fixed_vals, *,
-             w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
-             polish_chunk):
-    """The fused PH iteration: batched subproblem solve + Compute_Xbar +
-    Update_W + convergence + objectives + certified dual bound, one jitted
-    program.
+@partial(jax.jit, static_argnames=("w_on", "prox_on"))
+def _ph_assemble(data, c, W, xbar, rho, idx, fixed_mask, fixed_vals,
+                 wscale, *, w_on, prox_on):
+    """Stage 1: objective-rewrite + nonant pinning (cheap elementwise).
 
-    MODULE-LEVEL on purpose: every engine instance in the process (hub +
-    each spoke cylinder owns its own engine) shares ONE jit cache entry
-    per (mode, shapes) — per-instance closures would recompile the same
-    UC-sized program once per cylinder. Everything large (factors, data,
-    costs) is an ARGUMENT, not a closure constant: closing over batch
-    tensors would bake them into the lowered program as literals
-    (gigabytes at UC scale) and defeat the qp_state buffer donation."""
-    wvec = W - rho * xbar if (w_on and prox_on) else (
-        W if w_on else (-rho * xbar if prox_on else jnp.zeros_like(W)))
+    ``wscale`` ((S, K), or None for the uniform case) is the ratio
+    variable-probability / scenario-probability. The W term enters each
+    scenario objective scaled by it: the implied Lagrangian multipliers
+    are then lambda = vprob*W, which sum to zero per (node, slot) by the
+    vprob-weighted Compute_Xbar — keeping the Lagrangian/Ebound
+    CERTIFICATE valid under variable probabilities (the reference leaves
+    W unscaled there and its bounds silently lose validity in this
+    rarely-used corner; with uniform probabilities wscale == 1 and the
+    two coincide). Zero-probability entries get no W pressure at all —
+    the generalization of the reference's w_coeff mask
+    (ref. spbase.py:355, phbase.py:245-251)."""
+    Weff = W if wscale is None else W * wscale
+    wvec = Weff - rho * xbar if (w_on and prox_on) else (
+        Weff if w_on else (-rho * xbar if prox_on else jnp.zeros_like(W)))
     q = c.at[:, idx].add(wvec)
     # fixed nonants: pin boxes (ref. phbase.py:413 _fix_nonants)
     bl = data.lb.at[:, idx].set(
         jnp.where(fixed_mask, fixed_vals, data.lb[:, idx]))
     bu = data.ub.at[:, idx].set(
         jnp.where(fixed_mask, fixed_vals, data.ub[:, idx]))
-    d = data._replace(lb=bl, ub=bu)
-    qp_state, x, yA, yB = qp_solve(factors, d, q, qp_state,
-                                   max_iter=sub_max_iter,
-                                   eps_abs=sub_eps, eps_rel=sub_eps,
-                                   polish_chunk=polish_chunk)
+    return q, data._replace(lb=bl, ub=bu)
+
+
+@partial(jax.jit, static_argnames=("w_on", "slot_slices"))
+def _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w, memberships, idx,
+               W, rho, wmask, *, w_on, slot_slices):
+    """Stage 3: Compute_Xbar + Update_W + convergence + objectives +
+    certified dual bound (cheap reductions). ``wmask`` (None, or (S, K)
+    bool) zeroes the W of zero-probability entries — the reference's
+    w_coeff mask (ref. phbase.py:245-251)."""
     xn = x[:, idx]
     K = xn.shape[1]
     xbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn)
     xsqbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn * xn)
     W_new = W + rho * (xn - xbar_new)
+    if wmask is not None:
+        W_new = jnp.where(wmask, W_new, 0.0)
     conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
     base_obj = jnp.sum(c * x, axis=1) + c0 \
         + 0.5 * jnp.sum(P0 * x * x, axis=1)
@@ -84,6 +90,68 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
     # certified lower bound on each subproblem's optimum (valid for
     # prox-off solves; see qp_dual_objective)
     dual_obj = qp_dual_objective(d, q, c0, yA, yB, x_witness=x)
+    return xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj, \
+        dual_obj
+
+
+def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
+             idx, W, xbar, rho, fixed_mask, fixed_vals, wscale=None, *,
+             w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
+             polish_chunk, precision="native", tail_iter=1000,
+             sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
+             segment=500):
+    """The PH iteration: batched subproblem solve + Compute_Xbar +
+    Update_W + convergence + objectives + certified dual bound, staged as
+    THREE jitted programs (assemble / solve / reduce) rather than one
+    fused monolith: the fused UC-sized program crashed the experimental
+    TPU backend's worker above S≈64 and compiled minutes-slower, while
+    the three-call split dispatches in microseconds and shares the
+    solver's jit cache with every other qp_solve consumer.
+
+    MODULE-LEVEL on purpose: every engine instance in the process (hub +
+    each spoke cylinder owns its own engine) shares ONE jit cache entry
+    per (mode, shapes) — per-instance closures would recompile the same
+    UC-sized program once per cylinder. Everything large (factors, data,
+    costs) is an ARGUMENT, not a closure constant: closing over batch
+    tensors would bake them into the lowered program as literals
+    (gigabytes at UC scale)."""
+    q, d = _ph_assemble(data, c, W, xbar, rho, idx, fixed_mask, fixed_vals,
+                        wscale, w_on=w_on, prox_on=prox_on)
+    # The PH hot loop consumes only primal iterates (bounds come from
+    # prox-off solves), and on degenerate LPs the ADMM residuals plateau
+    # far above tight tolerances — a tight test would burn the whole
+    # iteration budget every PH iteration. Model configs that hit the
+    # plateau (UC) opt in via subproblem_eps_hot / subproblem_eps_dua_hot
+    # / subproblem_stall_rel: the LOOP criteria loosen for prox-on solves
+    # and the active-set polish carries the point to machine accuracy
+    # (measured: polish reaches ~1e-14 relative from a 1e-4-stalled loop
+    # point on UC). Defaults keep the strict contract everywhere.
+    e_pri = sub_eps_hot if (prox_on and sub_eps_hot is not None) else sub_eps
+    e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
+        else sub_eps
+    if precision == "mixed":
+        # f32 bulk + f64 tail (see qp_solve_mixed): data/state stay f64
+        qp_state, x, yA, yB = qp_solve_mixed(factors, d, q, qp_state,
+                                             max_iter=sub_max_iter,
+                                             tail_iter=tail_iter,
+                                             eps_abs=e_pri,
+                                             eps_rel=e_pri,
+                                             polish_chunk=polish_chunk,
+                                             eps_abs_dua=e_dua,
+                                             eps_rel_dua=e_dua,
+                                             stall_rel=stall_rel,
+                                             segment=segment)
+    else:
+        qp_state, x, yA, yB = qp_solve_segmented(
+            factors, d, q, qp_state, max_iter=sub_max_iter,
+            segment=segment, eps_abs=e_pri, eps_rel=e_pri,
+            polish_chunk=polish_chunk, eps_abs_dua=e_dua,
+            eps_rel_dua=e_dua, stall_rel=stall_rel)
+    wmask = None if wscale is None else wscale > 0
+    (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
+     dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
+                            memberships, idx, W, rho, wmask, w_on=w_on,
+                            slot_slices=slot_slices)
     return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
         conv, base_obj, solved_obj, dual_obj
 
@@ -103,6 +171,24 @@ class PHBase(SPBase):
         self.sub_max_iter = int(opts.get("subproblem_max_iter", 5000))
         # 1e-8 keeps the dual-objective bounds tight (f64); loosen on f32
         self.sub_eps = float(opts.get("subproblem_eps", 1e-8))
+        # "native": solve at self.dtype. "mixed": f32 bulk + f64 tail
+        # (requires dtype=f64 / x64 enabled) — the TPU-fast path that
+        # still meets certified-bound tolerances on badly-scaled LPs
+        self.sub_precision = str(opts.get("subproblem_precision", "native"))
+        self.sub_tail_iter = int(opts.get("subproblem_tail_iter", 1000))
+        # opt-in fast path for plateau-prone models (see _ph_step): loose
+        # hot-loop criteria + stall exit; None/0 = strict (default)
+        _h = opts.get("subproblem_eps_hot", None)
+        self.sub_eps_hot = None if _h is None else float(_h)
+        _hd = opts.get("subproblem_eps_dua_hot", None)
+        self.sub_eps_dua_hot = None if _hd is None else float(_hd)
+        self.sub_stall_rel = float(opts.get("subproblem_stall_rel", 0.0))
+        # per-device-call iteration segment (watchdog-safe executions)
+        self.sub_segment = int(opts.get("subproblem_segment", 500))
+        if self.sub_precision == "mixed" and self.dtype != jnp.float64:
+            raise ValueError("subproblem_precision='mixed' needs dtype="
+                             "float64 (enable jax_enable_x64); got "
+                             f"{self.dtype}")
         self.rho_setter = rho_setter
         self.extensions = extensions
         self.converger_cls = converger
@@ -125,6 +211,14 @@ class PHBase(SPBase):
             self.rho, self.W, self.xbar, self.xsqbar = (
                 jax.device_put(a, sh) for a in (self.rho, self.W, self.xbar,
                                                 self.xsqbar))
+        # variable-probability W scaling (see _ph_assemble): vprob/p,
+        # with zero-probability scenarios mapped to 0 (their subproblems
+        # carry no objective weight; an eps-floor division would overflow
+        # the assembled q instead)
+        self._w_scale = None if self.vprob is None else jnp.where(
+            self.prob[:, None] > 0, self.vprob
+            / jnp.where(self.prob[:, None] > 0, self.prob[:, None], 1.0),
+            0.0)
         self.x = None            # (S, n) latest subproblem solutions
         self.conv = None
         self._iter = 0
@@ -204,12 +298,10 @@ class PHBase(SPBase):
                          None)
             if other is not None and other.x.shape == st.x.shape \
                     and other.zA.shape == st.zA.shape:
-                # copy: the transplanted buffers will be DONATED by the next
-                # step call, and the source state must survive it
-                cp = jnp.copy
-                st = st._replace(x=cp(other.x), yA=cp(other.yA),
-                                 yB=cp(other.yB), zA=cp(other.zA),
-                                 zB=cp(other.zB))
+                # transplant the other mode's iterates as a warm start
+                # (buffers are never donated — sharing them is safe)
+                st = st._replace(x=other.x, yA=other.yA, yB=other.yB,
+                                 zA=other.zA, zB=other.zB)
             self._qp_states[key] = st
         return self._qp_states[key]
 
@@ -229,12 +321,16 @@ class PHBase(SPBase):
             qp_state, factors, data, self.c, self.c0, self.P_diag,
             self.prob, self.xbar_weights, tuple(self.memberships),
             self.nonant_idx, self.W, self.xbar, self.rho,
-            self._fixed_mask, self._fixed_vals,
+            self._fixed_mask, self._fixed_vals, self._w_scale,
             w_on=bool(w_on), prox_on=bool(prox_on),
             slot_slices=tuple(self.slot_slices),
             sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
             polish_chunk=int(self.options.get("subproblem_polish_chunk",
-                                              0)))
+                                              0)),
+            precision=self.sub_precision, tail_iter=self.sub_tail_iter,
+            sub_eps_hot=self.sub_eps_hot,
+            sub_eps_dua_hot=self.sub_eps_dua_hot,
+            stall_rel=self.sub_stall_rel, segment=self.sub_segment)
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB
@@ -308,7 +404,10 @@ class PHBase(SPBase):
 
     def Update_W(self):
         xn = self.nonants_of(self.x)
-        self.W = self.W + self.rho * (xn - self.xbar)
+        W = self.W + self.rho * (xn - self.xbar)
+        if self._w_scale is not None:
+            W = jnp.where(self._w_scale > 0, W, 0.0)
+        self.W = W
 
     def Ebound(self):
         """Expected certified subproblem lower bound (ref. phbase.py:314

@@ -26,7 +26,16 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         self.update_bound(self.opt.Ebound())
 
     def _bound_from_Ws(self, W_flat):
-        self.opt.W = jnp.asarray(W_flat, self.opt.dtype)
+        # Project the received W onto the dual-feasible manifold
+        # sum_s p_s W_s = 0 per (node, slot) by removing its p-weighted
+        # node mean. PH-generated W satisfies this in exact arithmetic,
+        # but the hub may run a lower precision (an f32 hot loop leaves
+        # O(1e-4·scale) mass), and the Lagrangian bound is only a valid
+        # outer bound on that manifold — the projection makes the
+        # certificate exact at THIS engine's precision.
+        W = jnp.asarray(W_flat, self.opt.dtype)
+        W = W - self.opt.compute_xbar(W)
+        self.opt.W = W
         self.opt.solve_loop(w_on=True, prox_on=False, update=False)
         return self.opt.Ebound()
 

@@ -62,18 +62,23 @@ def _network():
 
 
 def demand_path(scennum: int, branching=(2, 2, 2)):
-    """Per-stage demand multipliers along the scenario's node path: each
-    branch moves demand ±10% cumulatively (stage 1 is common)."""
+    """Per-stage demand multipliers along the scenario's node path
+    (stage 1 is common). Branch digit d of a b-way node moves demand by
+    a multiplier spread EVENLY over [+10%, -10%] — d=0 is +10%, d=b-1
+    is -10%, intermediate digits interpolate — so every sibling node
+    carries DISTINCT demand data at any branching factor (a constant
+    per-digit move would collapse b>2 siblings into duplicates)."""
     mults = [1.0]
     digits = []
     s = scennum
     for b in reversed(branching):
-        digits.append(s % b)
+        digits.append((s % b, b))
         s //= b
     digits = digits[::-1]
     level = 1.0
-    for t, d in enumerate(digits):
-        level *= 1.0 + (0.10 if d == 0 else -0.10)
+    for d, b in digits:
+        move = 0.10 if b <= 1 else 0.10 * (1.0 - 2.0 * d / (b - 1))
+        level *= 1.0 + move
         mults.append(level)
     return np.asarray(mults)          # (T,) with mults[0] = 1.0
 

@@ -93,7 +93,7 @@ class QPState(NamedTuple):
     yB: jax.Array         # (S, n) scaled bound duals
     zA: jax.Array         # (S, m) scaled row slacks
     zB: jax.Array         # (S, n) scaled bound slacks
-    L: jax.Array          # (S, n, n) | (n, n) Cholesky of current KKT matrix
+    L: jax.Array          # (S,n,n)|(n,n) KKT inverse (f64) / Cholesky (f32)
     rho_scale: jax.Array  # (S,) | () multiplier on the rho patterns
     iters: jax.Array      # scalar total ADMM iterations in last solve
     pri_res: jax.Array    # (S,) unscaled
@@ -148,39 +148,80 @@ def _ruiz_equilibrate(P_diag, A, iters=15):
 
 
 def _factorize(factors: QPFactors, rho_scale):
-    """Cholesky of M = diag(P_s) + sigma I + A_sᵀ diag(ρ_A) A_s + diag(g²ρ_b).
-    Shared mode (A_s (m,n), rho_scale scalar) returns one (n, n) factor."""
+    """EXPLICIT INVERSE of M = diag(P_s) + sigma I + A_sᵀ diag(ρ_A) A_s
+    + diag(g²ρ_b). Shared mode (A_s (m,n), rho_scale scalar) returns one
+    (n, n) inverse.
+
+    Why an inverse and not the Cholesky factor (f64): the ADMM x-update
+    runs thousands of times per solve, and a TPU triangular solve is a
+    SEQUENTIAL back-substitution — milliseconds of latency at small
+    batch — while applying a precomputed inverse is one MXU matmul
+    (microseconds). The inverse is computed ONCE per (re)factorization
+    via two n-RHS triangular solves (themselves MXU-blocked), and in f64
+    the equilibrated, sigma-regularized M keeps the inverse-apply error
+    far below the ADMM's own tolerance. In F32 the inverse's κ(M)·eps
+    error (~1e-1 on UC-class conditioning) destabilizes the iteration —
+    measured NaN blowups at S=256 — so the f32 path keeps the Cholesky
+    factor and pays the triangular solves. _chol_solve dispatches on the
+    stored matrix's dtype. The ill-conditioned penalty systems in the
+    POLISH always use honest Cholesky solves."""
     A_s, P_s = factors.A_s, factors.P_s
     g = factors.Eb * factors.D
     n = A_s.shape[-1]
+    invert = A_s.dtype == jnp.float64
     if A_s.ndim == 2:
         rA = factors.rho_A * rho_scale
         rB = factors.rho_b * rho_scale
         M = A_s.T @ (rA[:, None] * A_s)
         M = M + jnp.diag(P_s + factors.sigma + g * g * rB)
-        return jnp.linalg.cholesky(M)
+        L = jnp.linalg.cholesky(M)
+        if not invert:
+            return L
+        eye = jnp.eye(n, dtype=A_s.dtype)
+        w = jax.lax.linalg.triangular_solve(L, eye, left_side=True,
+                                            lower=True)
+        return jax.lax.linalg.triangular_solve(L, w, left_side=True,
+                                               lower=True, transpose_a=True)
     rA = factors.rho_A * rho_scale[:, None]
     rB = factors.rho_b * rho_scale[:, None]
     M = (A_s * rA[:, :, None]).swapaxes(1, 2) @ A_s
     M = M + jnp.eye(n, dtype=A_s.dtype) * factors.sigma
     M = M + jax.vmap(jnp.diag)(P_s + g * g * rB)
-    return jnp.linalg.cholesky(M)
+    L = jnp.linalg.cholesky(M)
+    if not invert:
+        return L
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=A_s.dtype), M.shape)
+    w = jax.lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return jax.lax.linalg.triangular_solve(L, w, left_side=True,
+                                           lower=True, transpose_a=True)
 
 
-def _chol_solve(L, b):
-    """Solve M x = b given Cholesky factor L; b (S, n). Shared L (n, n)
-    becomes one multi-RHS triangular solve (an (n,n)x(n,S) MXU pass)."""
-    if L.ndim == 2:
-        y = jax.lax.linalg.triangular_solve(L, b.T, left_side=True,
-                                            lower=True, transpose_a=False)
-        x = jax.lax.linalg.triangular_solve(L, y, left_side=True,
-                                            lower=True, transpose_a=True)
-        return x.T
+def _tri_solve(L, b):
+    """Solve M x = b given a true Cholesky factor L; b (S, n). Used by the
+    POLISH only (its rho_big penalty systems are too ill-conditioned for
+    an explicit inverse); the main loop applies _chol_solve's inverse."""
     y = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True,
                                         lower=True, transpose_a=False)
     x = jax.lax.linalg.triangular_solve(L, y, left_side=True,
                                         lower=True, transpose_a=True)
     return x[..., 0]
+
+
+def _chol_solve(F, b):
+    """Solve M x = b given _factorize's output F: an explicit inverse in
+    f64 (one MXU matmul — M⁻¹ is symmetric) or a Cholesky factor in f32
+    (triangular solves; see _factorize's docstring for why)."""
+    if F.dtype == jnp.float64:
+        if F.ndim == 2:
+            return b @ F
+        return jnp.einsum("sij,sj->si", F, b)
+    if F.ndim == 2:
+        y = jax.lax.linalg.triangular_solve(F, b.T, left_side=True,
+                                            lower=True, transpose_a=False)
+        x = jax.lax.linalg.triangular_solve(F, y, left_side=True,
+                                            lower=True, transpose_a=True)
+        return x.T
+    return _tri_solve(F, b)
 
 
 @partial(jax.jit, static_argnames=("eq_boost",))
@@ -246,13 +287,34 @@ def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
                    pri_rel=jnp.full((S,), jnp.inf, dt))
 
 
-@partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho",
-                                   "polish", "polish_iters", "polish_chunk"))
-def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
-             max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
-             alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
-             polish_chunk=0):
-    """Run ADMM until residuals pass (eps_abs, eps_rel) or max_iter, then
+def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
+                max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
+                alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
+                polish_chunk=0, eps_abs_dua=None, eps_rel_dua=None,
+                stall_rel=0.0):
+    """Traceable body of qp_solve (shared by the jitted single-precision
+    entry and the mixed-precision escalation driver below).
+
+    ``eps_*_dua`` (default: same as the primal pair) let a caller loosen
+    the DUAL termination test independently: on degenerate LPs the ADMM
+    dual residual plateaus (y drifts along redundant-row null spaces)
+    orders of magnitude above the primal one, and a consumer that only
+    needs primal iterates (the PH hot loop — bounds come from separate
+    prox-off solves) would otherwise burn its whole iteration budget
+    waiting on a test that cannot pass. The polish still runs and still
+    recovers the best certified duals it can.
+
+    STALL EXIT: degenerate LPs also plateau the PRIMAL residual above any
+    tight tolerance (first-order methods converge slowly along degenerate
+    faces). A scenario counts as finished when its residuals improved
+    less than 5% since the previous check AND its primal residual is
+    below the coarse ``stall_rel`` gate (relative) — at that point
+    further iterations tread water and the active-set polish is the
+    productive step. Checks immediately after a rho refactorize are
+    exempt (the residual jump would false-trigger). OFF by default
+    (stall_rel=0): exact consumers (tests, small well-conditioned
+    models) keep the strict contract; plateau-prone model configs (UC)
+    opt in via engine options.
     POLISH: detect the active set from the final slacks, factor the
     penalty KKT matrix restricted to active rows, and run a few
     augmented-Lagrangian refinement steps. First-order ADMM stalls on the
@@ -286,6 +348,8 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
     dt = A_s.dtype
     eps_abs = jnp.asarray(eps_abs, dt)
     eps_rel = jnp.asarray(eps_rel, dt)
+    eps_abs_dua = eps_abs if eps_abs_dua is None else jnp.asarray(eps_abs_dua, dt)
+    eps_rel_dua = eps_rel if eps_rel_dua is None else jnp.asarray(eps_rel_dua, dt)
 
     def rho_of(rho_scale):
         rs = rho_scale if shared else rho_scale[:, None]
@@ -317,22 +381,34 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
                                    x, yA, yB, zA, zB)
 
     def cond(carry):
-        *_, it, done = carry
+        it, done = carry[7], carry[8]
         return jnp.logical_and(it < max_iter, jnp.logical_not(done))
 
     def body(carry):
-        x, yA, yB, zA, zB, L, rho_scale, it, _ = carry
+        (x, yA, yB, zA, zB, L, rho_scale, it, _, best_pri, best_dua,
+         stall_ct) = carry
         rA, rB = rho_of(rho_scale)
         x, yA, yB, zA, zB = admm_chunk(x, yA, yB, zA, zB, L, rA, rB)
         pri, dua, pri_sc, dua_sc = residuals(x, yA, yB, zA, zB)
-        done = jnp.all(jnp.logical_and(pri <= eps_abs + eps_rel * pri_sc,
-                                       dua <= eps_abs + eps_rel * dua_sc))
+        conv_ok = jnp.logical_and(
+            pri <= eps_abs + eps_rel * pri_sc,
+            dua <= eps_abs_dua + eps_rel_dua * dua_sc)
+        # stall exit (window-based, oscillation-robust): a scenario whose
+        # BEST residual pair hasn't improved 5% in 4 consecutive checks
+        # while its primal passes the coarse gate is plateaued — the
+        # productive next step is the polish, not more iterations
+        if stall_rel:
+            improved = (pri <= 0.95 * best_pri) | (dua <= 0.95 * best_dua)
+            best_pri = jnp.minimum(best_pri, pri)
+            best_dua = jnp.minimum(best_dua, dua)
+        rho_changed = jnp.array(False)
         if adaptive_rho:
             # OSQP-style infrequent adaptation: every 4th residual check;
             # adopt only when the ideal rho moved by > 5x. In shared mode
             # the scale is a single scalar (geometric mean of the
             # per-scenario ideals) so the factor stays shared.
             adapt_now = ((it // check_every) % 4) == 3
+            not_conv = jnp.logical_not(jnp.all(conv_ok))
             ratio_s = jnp.sqrt((pri / pri_sc)
                                / jnp.maximum(dua / dua_sc, 1e-30))
             if shared:
@@ -341,24 +417,38 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
                 new_scale = jnp.clip(rho_scale * ratio, 1e-6, 1e6)
                 change = jnp.maximum(new_scale / rho_scale,
                                      rho_scale / new_scale)
-                upd = (change > 5.0) & adapt_now & jnp.logical_not(done)
+                upd = (change > 5.0) & adapt_now & not_conv
                 rho_scale = jnp.where(upd, new_scale, rho_scale)
                 need = upd
             else:
                 new_scale = jnp.clip(rho_scale * ratio_s, 1e-6, 1e6)
                 change = jnp.maximum(new_scale / rho_scale,
                                      rho_scale / new_scale)
-                mask = (change > 5.0) & adapt_now & jnp.logical_not(done)
+                mask = (change > 5.0) & adapt_now & not_conv
                 rho_scale = jnp.where(mask, new_scale, rho_scale)
                 need = jnp.any(mask)
             L = jax.lax.cond(need, lambda: _factorize(factors, rho_scale),
                              lambda: L)
-        return (x, yA, yB, zA, zB, L, rho_scale, it + check_every, done)
+            rho_changed = need
+        if stall_rel:
+            # a rho refactorize resets the window (the residual jump is
+            # expected, not a plateau)
+            stall_ct = jnp.where(improved | rho_changed, 0, stall_ct + 1)
+            stalled = (stall_ct >= 4) & (pri <= stall_rel * pri_sc)
+        else:
+            stalled = jnp.zeros_like(conv_ok)
+        done = jnp.all(conv_ok | stalled)
+        return (x, yA, yB, zA, zB, L, rho_scale, it + check_every, done,
+                best_pri, best_dua, stall_ct)
 
-    x, yA, yB, zA, zB, L, rho_scale, it, _ = jax.lax.while_loop(
+    S_ = data.l.shape[0]
+    inf0 = jnp.full((S_,), jnp.inf, dt)
+    ct0 = jnp.zeros((S_,), jnp.int32)
+    x, yA, yB, zA, zB, L, rho_scale, it, _, _, _, _ = jax.lax.while_loop(
         cond, body,
         (state.x, state.yA, state.yB, state.zA, state.zB, state.L,
-         state.rho_scale, jnp.zeros((), jnp.int32), jnp.array(False)))
+         state.rho_scale, jnp.zeros((), jnp.int32), jnp.array(False),
+         inf0, inf0, ct0))
 
     pri, dua, pri_sc, dua_sc = residuals(x, yA, yB, zA, zB)
     # the ADMM iterates are what the NEXT solve warm-starts from (the
@@ -422,6 +512,146 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
     new_state = new_state._replace(pri_res=pri, dua_res=dua,
                                    pri_rel=pri / pri_sc)
     return new_state, x_un, yA_un, yB_un
+
+
+@partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho",
+                                   "polish", "polish_iters", "polish_chunk",
+                                   "stall_rel"))
+def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
+             max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
+             alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
+             polish_chunk=0, eps_abs_dua=None, eps_rel_dua=None,
+             stall_rel=0.0):
+    """Jitted single-precision solve — see _solve_impl for the algorithm."""
+    return _solve_impl(factors, data, q, state, max_iter, check_every,
+                       eps_abs, eps_rel, alpha, adaptive_rho, polish,
+                       polish_iters, polish_chunk, eps_abs_dua, eps_rel_dua,
+                       stall_rel)
+
+
+def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
+                       max_iter=4000, segment=500, **kw):
+    """Host-driven segmented solve: run the jitted loop in warm-started
+    SEGMENTS of at most ``segment`` iterations (polish deferred to one
+    final call), accumulating until convergence/stall or ``max_iter``.
+
+    Exists because a single long device execution (thousands of ADMM
+    iterations in one while_loop) can exceed an accelerator runtime's
+    per-execution watchdog — observed as hard TPU worker crashes on
+    UC-size solves above ~500 f64 iterations per call. Segmenting costs
+    one host dispatch per ``segment`` iterations (microseconds against
+    tens of milliseconds of device work) and buys bounded execution
+    times, warm-started continuation, and a natural place for host-side
+    progress control. Returns the same (state, x, yA, yB) contract."""
+    final_polish = kw.pop("polish", True)
+    total = 0
+    while total < max_iter:
+        seg = min(segment, max_iter - total)
+        state, _, _, _ = qp_solve(factors, data, q, state, max_iter=seg,
+                                  polish=False, **kw)
+        ran = int(state.iters)
+        total += ran
+        if ran < seg:       # early exit: converged or stalled
+            break
+    # final call: loop skipped (max_iter=0), polish runs
+    state, x, yA, yB = qp_solve(factors, data, q, state, max_iter=0,
+                                polish=final_polish, **kw)
+    state = state._replace(iters=jnp.asarray(total, jnp.int32))
+    return state, x, yA, yB
+
+
+def _cast_floats(tree, dt):
+    """Cast the floating leaves of a NamedTuple pytree; ints ride along."""
+    return jax.tree.map(
+        lambda a: a.astype(dt)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
+                   max_iter=4000, tail_iter=1000, check_every=25,
+                   eps_abs=1e-6, eps_rel=1e-6, alpha=1.6, adaptive_rho=True,
+                   polish=True, polish_iters=12, polish_chunk=0,
+                   eps_abs_dua=None, eps_rel_dua=None, stall_rel=0.0,
+                   segment=500):
+    """Precision-escalated solve: an f32 bulk phase (MXU-friendly — the
+    thousands of ADMM matmuls run at accelerator speed) followed by an f64
+    tail (one refactorization + a few hundred iterations + the polish).
+
+    Rationale: pure-f32 ADMM stalls at a relative-residual noise floor of
+    ~1e-2 on badly scaled LPs (UC: costs spanning 1e1..5e3, loads ~2e3),
+    far above the 1e-4..1e-6 the certified bounds and incumbent
+    feasibility checks need; pure f64 wastes the accelerator on iterations
+    that don't need the precision. The f32 phase does the convergence
+    work, the f64 tail does the accuracy work. Everything (factors, data,
+    state) arrives in f64; the f32 copies are cast inside the jit.
+
+    tail_iter bounds the f64 phase; rho adaptation stays on in both
+    phases (the tail refactorizes in f64 when the ratio moves >5x — a
+    few hundred ms, worth it when the f32 handoff mis-scaled rho). Both
+    phases run SEGMENTED (at most ``segment`` iterations per device
+    execution) for the same watchdog reason as qp_solve_segmented.
+    Returns the same (state, x, yA, yB) contract as qp_solve, with the
+    state in f64.
+    """
+    lo = jnp.float32
+    f_lo = _cast_floats(factors, lo)
+    d_lo = _cast_floats(data, lo)
+    st_lo = _cast_floats(state, lo)
+    st_lo = st_lo._replace(L=_factorize(f_lo, st_lo.rho_scale))
+    # the f32 phase is a WARM START for the f64 phase: stop it at its
+    # noise floor (~1e-3 relative on badly-scaled LPs) — iterating f32
+    # past that treads water and, worse, feeds the rho adaptation noise
+    eps_lo = jnp.maximum(jnp.asarray(eps_abs, lo), 1e-4)
+    eps_rel_lo = jnp.maximum(jnp.asarray(eps_rel, lo), 1e-3)
+    # the f32 dual residual plateaus well above the primal one; require
+    # only a coarse dual level before handing off
+    eps_rel_lo_dua = jnp.maximum(
+        jnp.asarray(eps_rel if eps_rel_dua is None else eps_rel_dua, lo),
+        1e-2)
+    lo_total = 0
+    while lo_total < max_iter:
+        seg = min(segment, max_iter - lo_total)
+        st_lo, _, _, _ = _solve_lo_jit(f_lo, d_lo, q.astype(lo), st_lo,
+                                       seg, check_every, eps_lo,
+                                       eps_rel_lo, alpha, adaptive_rho,
+                                       polish_iters, eps_rel_lo_dua,
+                                       stall_rel)
+        ran = int(st_lo.iters)
+        lo_total += ran
+        if ran < seg:
+            break
+    dt_hi = state.x.dtype
+    rho_hi = st_lo.rho_scale.astype(dt_hi)
+    st_hi = _cast_floats(st_lo, dt_hi)._replace(
+        L=_factorize(factors, rho_hi), rho_scale=rho_hi)
+    # the f64 tail is the real solver: full termination test, rho
+    # adaptation on (it refactorizes in f64 when needed), early exit when
+    # the warm start was already good (prox-regularized solves)
+    st_hi, x, yA, yB = qp_solve_segmented(
+        factors, data, q, st_hi, max_iter=tail_iter, segment=segment,
+        check_every=check_every, eps_abs=eps_abs, eps_rel=eps_rel,
+        alpha=alpha, adaptive_rho=adaptive_rho, polish=polish,
+        polish_iters=polish_iters, polish_chunk=polish_chunk,
+        eps_abs_dua=eps_abs_dua, eps_rel_dua=eps_rel_dua,
+        stall_rel=stall_rel)
+    # total iteration count across both phases
+    st_hi = st_hi._replace(iters=jnp.asarray(lo_total, jnp.int32)
+                           + st_hi.iters)
+    return st_hi, x, yA, yB
+
+
+@partial(jax.jit, static_argnames=("max_iter", "check_every",
+                                   "adaptive_rho", "polish_iters",
+                                   "stall_rel"))
+def _solve_lo_jit(f_lo, d_lo, q_lo, st_lo, max_iter, check_every, eps_abs,
+                  eps_rel, alpha, adaptive_rho, polish_iters, eps_rel_dua,
+                  stall_rel):
+    """One polish-free f32 segment of qp_solve_mixed."""
+    st_lo, _, _, _ = _solve_impl(f_lo, d_lo, q_lo, st_lo, max_iter,
+                                 check_every, eps_abs, eps_rel, alpha,
+                                 adaptive_rho, False, polish_iters, 0,
+                                 eps_abs, eps_rel_dua, stall_rel)
+    return st_lo, None, None, None
 
 
 def _unscaled_residuals(A_s, P_s, g, D, E, Eb, csx, q_s, x, yA, yB, zA, zB):
@@ -522,9 +752,9 @@ def _polish_select(A_s, P_s, g, D, E, Eb, cs, csx, sigma, data, q, q_s,
             x_prev, yA_p, yB_p = carry
             rhs = sigma * x_prev - q_s + _ATy(A_b, rpA * bA - yA_p) \
                 + g * (rpB * bB - yB_p)
-            x_p = _chol_solve(Lp, rhs)
-            x_p = x_p + _chol_solve(Lp, rhs - apply_Mp(x_p))
-            x_p = x_p + _chol_solve(Lp, rhs - apply_Mp(x_p))
+            x_p = _tri_solve(Lp, rhs)
+            x_p = x_p + _tri_solve(Lp, rhs - apply_Mp(x_p))
+            x_p = x_p + _tri_solve(Lp, rhs - apply_Mp(x_p))
             yA_p = yA_p + rpA * (_Ax(A_b, x_p) - bA)
             yB_p = yB_p + rpB * (g * x_p - bB)
             return (x_p, yA_p, yB_p), None
@@ -551,8 +781,8 @@ def _polish_select(A_s, P_s, g, D, E, Eb, cs, csx, sigma, data, q, q_s,
             x_prev, yA_p, yB_p = carry
             rhs = sigma * x_prev - q_s + _ATy(A_b, rpA * bA - yA_p) \
                 + g * (rpB * bB - yB_p)
-            x_p = _chol_solve(Lp, rhs)
-            x_p = x_p + _chol_solve(Lp, rhs - apply_Mp(x_p))
+            x_p = _tri_solve(Lp, rhs)
+            x_p = x_p + _tri_solve(Lp, rhs - apply_Mp(x_p))
             yA_p = clampy(yA_p + rpA * (_Ax(A_b, x_p) - bA), alA, auA, eqA)
             yB_p = clampy(yB_p + rpB * (g * x_p - bB), alB, auB, eqB)
             return (x_p, yA_p, yB_p), None

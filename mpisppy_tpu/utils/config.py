@@ -85,6 +85,7 @@ class RunConfig:
     num_bundles: int = 0             # 0 = no bundling
     hub: str = "ph"
     algo: AlgoConfig = field(default_factory=AlgoConfig)
+    hub_options: dict = field(default_factory=dict)  # hub-engine overrides
     spokes: list = field(default_factory=list)   # list[SpokeConfig]
     rel_gap: float | None = None
     abs_gap: float | None = None

@@ -11,6 +11,20 @@ from __future__ import annotations
 
 from .config import RunConfig, SpokeConfig
 
+_DTYPES = {"float32": "float32", "f32": "float32",
+           "float64": "float64", "f64": "float64"}
+
+
+def _pop_dtype(options):
+    """Extract an optional per-cylinder "dtype" option ("float32"/"f64"/…)
+    into an engine dtype kwarg — e.g. an f32 hub for hot-loop speed with
+    f64 bound spokes for certified tightness in the same wheel."""
+    name = options.pop("dtype", None)
+    if name is None:
+        return {}
+    import jax.numpy as jnp
+    return {"dtype": getattr(jnp, _DTYPES[str(name)])}
+
 
 def build_batch_for(cfg: RunConfig):
     """Model registry: name -> stacked batch (+ bundling)."""
@@ -20,12 +34,20 @@ def build_batch_for(cfg: RunConfig):
     mod = getattr(models, cfg.model)
     kwargs = dict(cfg.model_kwargs)
     if cfg.model in ("hydro", "ccopf"):
-        tk = kwargs.pop("tree_kwargs", {})
+        # the creator decodes scenario numbers with the SAME branching the
+        # tree was built with — they must never diverge, whether the user
+        # passed the branching under tree_kwargs or directly in
+        # model_kwargs. Merge both into one source of truth.
+        tk = dict(kwargs.pop("tree_kwargs", {}))
+        bkey = "branching" if cfg.model == "ccopf" else "branching_factors"
+        if bkey in kwargs:
+            if bkey in tk and tuple(tk[bkey]) != tuple(kwargs[bkey]):
+                raise ValueError(
+                    f"{cfg.model}: {bkey} given in both model_kwargs and "
+                    "tree_kwargs with different values")
+            tk.setdefault(bkey, kwargs[bkey])
         tree = mod.make_tree(**tk)
-        if cfg.model == "ccopf":
-            # the creator decodes scenario numbers with the SAME branching
-            # the tree was built with — they must never diverge
-            kwargs.update(tk)
+        kwargs.update(tk)
     else:
         tree = mod.make_tree(cfg.num_scens)
     batch = build_batch(mod.scenario_creator, tree, creator_kwargs=kwargs)
@@ -44,6 +66,8 @@ def hub_dict(cfg: RunConfig):
     from ..cylinders.hub import PHHub, APHHub, LShapedHub, CrossScenarioHub
 
     options = cfg.algo.to_options()
+    options.update(cfg.hub_options)
+    dtype_kw = _pop_dtype(options)
     hub_kwargs = {"options": {}}
     if cfg.rel_gap is not None:
         hub_kwargs["options"]["rel_gap"] = cfg.rel_gap
@@ -61,7 +85,7 @@ def hub_dict(cfg: RunConfig):
     return {"hub_class": hub_cls, "hub_kwargs": hub_kwargs,
             "opt_class": opt_cls,
             "opt_kwargs": {"batch": build_batch_for(cfg),
-                           "options": options}}
+                           "options": options, **dtype_kw}}
 
 
 def spoke_classes(kind: str):
@@ -101,13 +125,14 @@ def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
     spoke_cls, opt_cls = spoke_classes(sp.kind)
     options = cfg.algo.to_options()
     options.update(sp.options)
+    dtype_kw = _pop_dtype(options)
     spoke_kwargs = {}
     if cfg.trace_prefix:
         spoke_kwargs["trace_prefix"] = cfg.trace_prefix
     return {"spoke_class": spoke_cls, "spoke_kwargs": spoke_kwargs,
             "opt_class": opt_cls,
             "opt_kwargs": {"batch": build_batch_for(cfg),
-                           "options": options}}
+                           "options": options, **dtype_kw}}
 
 
 def wheel_dicts(cfg: RunConfig):
