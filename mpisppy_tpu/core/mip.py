@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.qp_solver import qp_solve, qp_objective, _Ax
+from ..ops.qp_solver import (qp_solve_segmented, qp_objective,
+                             _Ax)
 
 
 def _dive_once(factors, data, q, state, imask, round_offset,
@@ -64,8 +65,11 @@ def _dive_once(factors, data, q, state, imask, round_offset,
     def solve(lb_, ub_, st_, tight=False):
         d = data._replace(lb=jnp.asarray(lb_), ub=jnp.asarray(ub_))
         e = eps if tight else eps_mid
-        return qp_solve(factors, d, q, st_, max_iter=max_iter,
-                        eps_abs=e, eps_rel=e, polish_chunk=polish_chunk)
+        # segmented: a dive round can run thousands of iterations, and
+        # single long device executions trip accelerator watchdogs
+        return qp_solve_segmented(factors, d, q, st_, max_iter=max_iter,
+                                  eps_abs=e, eps_rel=e,
+                                  polish_chunk=polish_chunk)
 
     def feas(st_):
         return np.asarray((st_.pri_res <= 10 * feas_tol)

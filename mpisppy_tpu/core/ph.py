@@ -514,6 +514,46 @@ class PHBase(SPBase):
              self._last_base_obj, self._last_solved_obj,
              self._last_dual_obj) = saved
 
+    def dive_nonant_candidates(self, X=None, feas_tol=1e-3, max_iter=None):
+        """Per-scenario INTEGER-FEASIBLE nonant schedules via the batched
+        dive — incumbent candidates for the x̂ spokes on integer models.
+
+        Rounding a fractional LP nonant block (the reference-shaped
+        candidate source) routinely breaks covering rows with no slack
+        (UC reserve: rounded-down commitments force VOLL shedding);
+        the reference never sees this because its subproblem solves are
+        MIPs whose first stages are already integral
+        (ref. xhatshufflelooper_bounder.py:108 uses solved scenario
+        values). The TPU analog: dive every scenario's subproblem to
+        integer feasibility on the NONANT integer mask, prox-regularized
+        toward ``X`` (the hub's consensus) when given — strongly convex
+        inner solves, candidates that track the hub's trajectory.
+
+        Returns (cands (S, K), feasible (S,) bool)."""
+        from .mip import dive_integers
+        n = self.batch.n
+        idx_np = np.asarray(self.batch.nonant_idx)
+        imask = np.zeros(n, bool)
+        imask[idx_np] = np.asarray(self.batch.integer)[idx_np]
+        if not imask.any():
+            xn = self._hub_nonants() if X is None else jnp.asarray(X)
+            return np.asarray(xn), np.ones(self.batch.S, bool)
+        prox_on = X is not None
+        factors, d = self._get_factors(prox_on)
+        if prox_on:
+            q = self.c.at[:, self.nonant_idx].add(
+                -self.rho * jnp.asarray(X, self.dtype))
+        else:
+            q = self.c
+        st = self._ensure_state(prox_on)
+        x, _, feasible, _ = dive_integers(
+            factors, d, q, self.c0, st, jnp.asarray(imask),
+            max_iter=int(max_iter or min(self.sub_max_iter, 1500)),
+            eps=max(self.sub_eps, 1e-6), feas_tol=feas_tol,
+            polish_chunk=int(self.options.get("subproblem_polish_chunk",
+                                              0)))
+        return np.asarray(x)[:, idx_np], np.asarray(feasible)
+
     def _hub_nonants(self):
         """(S, K) latest subproblem nonant values for cylinder traffic
         (ref. phbase.py:562-617 nonant flat caches)."""

@@ -110,11 +110,14 @@ class Hub(SPCommunicator):
         abs_gap, rel_gap = self.compute_gaps()
         abs_opt = self.options.get("abs_gap", None)
         rel_opt = self.options.get("rel_gap", None)
-        if abs_opt is not None and abs_gap <= abs_opt:
-            return True
-        if rel_opt is not None and rel_gap <= rel_opt:
-            return True
-        return False
+        hit = (abs_opt is not None and abs_gap <= abs_opt) or \
+            (rel_opt is not None and rel_gap <= rel_opt)
+        if hit and not hasattr(self, "gap_reached_at"):
+            # first instant the gap target was observed (time-to-gap
+            # benchmarks read this; perf_counter, not wall time)
+            import time
+            self.gap_reached_at = time.perf_counter()
+        return hit
 
     def screen_trace(self, it):
         # print a row only when a bound moved (ref. hub.py:108-121)

@@ -18,10 +18,36 @@ from .spoke import OuterBoundWSpoke, OuterBoundNonantSpoke
 
 
 class LagrangianOuterBound(OuterBoundWSpoke):
+    """Two bound engines, selected by the ``lagrangian_exact_oracle``
+    option:
+
+    - default: the batched on-device solve + certified dual bound
+      (valid at ANY solve accuracy, tight once duals converge);
+    - exact oracle: per-scenario host HiGHS LPs (utils/host_oracle) —
+      exact L(W), the analog of the reference's spoke renting a CPU
+      simplex per scenario (ref. lagrangian_bounder.py:5-87). Linear
+      objectives only; the spoke is asynchronous so host latency never
+      blocks the hub."""
     converger_spoke_char = "L"
+
+    @property
+    def _exact(self):
+        # the host oracle evaluates sum_s p_s (min f_s + W_s x), which is
+        # a valid outer bound only on the sum_s p_s W_s = 0 manifold —
+        # under VARIABLE probabilities the engine's W lives on the
+        # vprob-weighted manifold instead, so the oracle silently falls
+        # back to the (vprob-aware) certified device bound
+        return bool(self.options.get("lagrangian_exact_oracle", False)) \
+            and getattr(self.opt, "vprob", None) is None
 
     def lagrangian_prep(self):
         """Trivial bound before any W arrives (ref. lagrangian_bounder.py:20-52)."""
+        if self._exact:
+            from ..utils.host_oracle import exact_lagrangian_bound
+            b = exact_lagrangian_bound(self.opt.batch, self.opt.batch.prob)
+            if b is not None:
+                self.update_bound(b)
+            return
         self.opt.solve_loop(w_on=False, prox_on=False, update=False)
         self.update_bound(self.opt.Ebound())
 
@@ -35,6 +61,12 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         # certificate exact at THIS engine's precision.
         W = jnp.asarray(W_flat, self.opt.dtype)
         W = W - self.opt.compute_xbar(W)
+        if self._exact:
+            from ..utils.host_oracle import exact_lagrangian_bound
+            import numpy as np
+            return exact_lagrangian_bound(self.opt.batch,
+                                          self.opt.batch.prob,
+                                          np.asarray(W))
         self.opt.W = W
         self.opt.solve_loop(w_on=True, prox_on=False, update=False)
         return self.opt.Ebound()
@@ -46,7 +78,9 @@ class LagrangianOuterBound(OuterBoundWSpoke):
             if not fresh or values is None:
                 continue
             W, _ = self.unpack_hub(values)
-            self.update_bound(self._bound_from_Ws(W))
+            bound = self._bound_from_Ws(W)
+            if bound is not None:       # None: an oracle solve failed
+                self.update_bound(bound)
 
 
 class LagrangerOuterBound(OuterBoundNonantSpoke):

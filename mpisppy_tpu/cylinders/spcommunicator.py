@@ -122,7 +122,14 @@ class SPCommunicator:
 
     def __init__(self, spbase_object, options=None):
         self.opt = spbase_object
-        self.options = dict(options or {})
+        # Communicator options LAYER OVER the engine's: vanilla puts
+        # SpokeConfig.options into the ENGINE (opt_kwargs["options"]),
+        # and spin_the_wheel builds communicators with no options of
+        # their own — without the merge, every spoke-level knob
+        # (lagrangian_exact_oracle, xhat_scen_limit, ...) configured
+        # through the config tree would be silently dead.
+        self.options = dict(getattr(spbase_object, "options", {}) or {})
+        self.options.update(options or {})
         # back-pointer used by engines to call sync() mid-iteration
         # (ref. spbase.py:503-514 weakref spcomm setter)
         self.opt.spcomm = weakref.proxy(self)

@@ -48,13 +48,26 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
                 self.best_xhat = self.opt.round_nonants(xhat)
                 self.update_bound(obj)
 
+    def _prepare_candidates(self, X):
+        """On integer-nonant models, replace the hub's fractional nonant
+        block with per-scenario DIVED integer-feasible schedules
+        prox-centered on it (see PHBase.dive_nonant_candidates) —
+        rounding fractional commitments breaks slack-free covering rows.
+        Gated by ``xhat_dive_candidates`` (default on)."""
+        if not self.options.get("xhat_dive_candidates", True):
+            return X
+        if not bool(np.asarray(self.opt.nonant_integer_mask).any()):
+            return X
+        cands, feasible = self.opt.dive_nonant_candidates(X)
+        return np.where(feasible[:, None], cands, np.asarray(X))
+
     def main(self):
         while not self.got_kill_signal():
             fresh, values = self.spoke_from_hub()
             if not fresh or values is None:
                 continue
             _, X = self.unpack_hub(values)
-            self.try_candidates(X)
+            self.try_candidates(self._prepare_candidates(X))
 
     def finalize(self):
         """Return (bound, best_xhat) (ref. xhatshufflelooper_bounder.py:198

@@ -487,7 +487,7 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
             ps["q"], ps["q_s"], ps["l_s"], ps["u_s"], ps["lb_s"], ps["ub_s"],
             ps["x"], ps["yA"], ps["yB"], ps["zA"], ps["zB"],
             ps["pri"], ps["dua"], ps["pri_sc"], ps["dua_sc"],
-            polish_iters, shared)
+            polish_iters, shared, eps_abs, eps_rel)
         return out
 
     S = data.l.shape[0]
@@ -546,12 +546,16 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
     final_polish = kw.pop("polish", True)
     total = 0
     while total < max_iter:
-        seg = min(segment, max_iter - total)
-        state, _, _, _ = qp_solve(factors, data, q, state, max_iter=seg,
-                                  polish=False, **kw)
+        # always run FULL segments: max_iter is a static jit arg, so a
+        # data-dependent remainder would compile a whole extra UC-sized
+        # program per distinct remainder (~minutes each on a slow
+        # compile path); overshoot is bounded by one segment and the
+        # convergence/stall exit stops early anyway
+        state, _, _, _ = qp_solve(factors, data, q, state,
+                                  max_iter=segment, polish=False, **kw)
         ran = int(state.iters)
         total += ran
-        if ran < seg:       # early exit: converged or stalled
+        if ran < segment:   # early exit: converged or stalled
             break
     # final call: loop skipped (max_iter=0), polish runs
     state, x, yA, yB = qp_solve(factors, data, q, state, max_iter=0,
@@ -610,15 +614,16 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
         1e-2)
     lo_total = 0
     while lo_total < max_iter:
-        seg = min(segment, max_iter - lo_total)
+        # constant segment size — see qp_solve_segmented on why the
+        # remainder must not become a fresh static max_iter
         st_lo, _, _, _ = _solve_lo_jit(f_lo, d_lo, q.astype(lo), st_lo,
-                                       seg, check_every, eps_lo,
+                                       segment, check_every, eps_lo,
                                        eps_rel_lo, alpha, adaptive_rho,
                                        polish_iters, eps_rel_lo_dua,
                                        stall_rel)
         ran = int(st_lo.iters)
         lo_total += ran
-        if ran < seg:
+        if ran < segment:
             break
     dt_hi = state.x.dtype
     rho_hi = st_lo.rho_scale.astype(dt_hi)
@@ -682,7 +687,8 @@ def _unscaled_residuals(A_s, P_s, g, D, E, Eb, csx, q_s, x, yA, yB, zA, zB):
 
 def _polish_select(A_s, P_s, g, D, E, Eb, cs, csx, sigma, data, q, q_s,
                    l_s, u_s, lb_s, ub_s, x, yA, yB, zA, zB,
-                   pri, dua, pri_sc, dua_sc, polish_iters, shared):
+                   pri, dua, pri_sc, dua_sc, polish_iters, shared,
+                   eps_abs=1e-6, eps_rel=1e-6):
     """Active-set polish (OSQP sec 5.2, batched) + dual-candidate
     selection. Three candidates are produced:
 
@@ -799,7 +805,13 @@ def _polish_select(A_s, P_s, g, D, E, Eb, cs, csx, sigma, data, q, q_s,
                                                      zA_p, zB_p)
         score = jnp.maximum(pri / pri_sc, dua / dua_sc)
         score_p = jnp.maximum(pri_p / pri_sc_p, dua_p / dua_sc_p)
-        ok = (score_p < score)[:, None]
+        # a candidate may trade primal for dual accuracy on the max-score
+        # ONLY while staying inside the requested primal tolerance band —
+        # PH/incumbent consumers read x for primal feasibility, and a
+        # polish that "improves" a converged point to 1e-3 violation
+        # breaks them (duals still improve via the separate dual-argmax)
+        band = jnp.maximum(pri, eps_abs + eps_rel * pri_sc)
+        ok = ((score_p < score) & (pri_p <= band))[:, None]
         return (jnp.where(ok, x_p, x), jnp.where(ok, yA_p, yA),
                 jnp.where(ok, yB_p, yB),
                 jnp.where(ok[:, 0], pri_p, pri),
