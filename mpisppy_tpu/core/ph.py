@@ -99,7 +99,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
              polish_chunk, precision="native", tail_iter=1000,
              sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
-             segment=500):
+             segment=500, polish_hot=True):
     """The PH iteration: batched subproblem solve + Compute_Xbar +
     Update_W + convergence + objectives + certified dual bound, staged as
     THREE jitted programs (assemble / solve / reduce) rather than one
@@ -129,6 +129,11 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
     e_pri = sub_eps_hot if (prox_on and sub_eps_hot is not None) else sub_eps
     e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
         else sub_eps
+    # The polish serves DUAL accuracy (certified bounds) and final primal
+    # refinement; the PH hot loop consumes only the primal iterate at the
+    # loop's own tolerance, so prox-on solves can skip the batched
+    # (S, n, n) penalty factorizations entirely (subproblem_polish_hot)
+    do_polish = polish_hot or not prox_on
     if precision == "mixed":
         # f32 bulk + f64 tail (see qp_solve_mixed): data/state stay f64
         qp_state, x, yA, yB = qp_solve_mixed(factors, d, q, qp_state,
@@ -140,13 +145,14 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
                                              eps_abs_dua=e_dua,
                                              eps_rel_dua=e_dua,
                                              stall_rel=stall_rel,
-                                             segment=segment)
+                                             segment=segment,
+                                             polish=do_polish)
     else:
         qp_state, x, yA, yB = qp_solve_segmented(
             factors, d, q, qp_state, max_iter=sub_max_iter,
             segment=segment, eps_abs=e_pri, eps_rel=e_pri,
             polish_chunk=polish_chunk, eps_abs_dua=e_dua,
-            eps_rel_dua=e_dua, stall_rel=stall_rel)
+            eps_rel_dua=e_dua, stall_rel=stall_rel, polish=do_polish)
     wmask = None if wscale is None else wscale > 0
     (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
      dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
@@ -185,6 +191,7 @@ class PHBase(SPBase):
         self.sub_stall_rel = float(opts.get("subproblem_stall_rel", 0.0))
         # per-device-call iteration segment (watchdog-safe executions)
         self.sub_segment = int(opts.get("subproblem_segment", 500))
+        self.sub_polish_hot = bool(opts.get("subproblem_polish_hot", True))
         if self.sub_precision == "mixed" and self.dtype != jnp.float64:
             raise ValueError("subproblem_precision='mixed' needs dtype="
                              "float64 (enable jax_enable_x64); got "
@@ -330,7 +337,8 @@ class PHBase(SPBase):
             precision=self.sub_precision, tail_iter=self.sub_tail_iter,
             sub_eps_hot=self.sub_eps_hot,
             sub_eps_dua_hot=self.sub_eps_dua_hot,
-            stall_rel=self.sub_stall_rel, segment=self.sub_segment)
+            stall_rel=self.sub_stall_rel, segment=self.sub_segment,
+            polish_hot=self.sub_polish_hot)
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB

@@ -33,12 +33,15 @@ class LagrangianOuterBound(OuterBoundWSpoke):
     @property
     def _exact(self):
         # the host oracle evaluates sum_s p_s (min f_s + W_s x), which is
-        # a valid outer bound only on the sum_s p_s W_s = 0 manifold —
-        # under VARIABLE probabilities the engine's W lives on the
-        # vprob-weighted manifold instead, so the oracle silently falls
-        # back to the (vprob-aware) certified device bound
+        # a valid outer bound only on the sum_s p_s W_s = 0 manifold and
+        # only for LINEAR objectives — under VARIABLE probabilities the
+        # engine's W lives on the vprob-weighted manifold, and quadratic
+        # models have no host LP form, so both fall back silently to the
+        # (vprob-aware, quadratic-capable) certified device bound
+        import numpy as np
         return bool(self.options.get("lagrangian_exact_oracle", False)) \
-            and getattr(self.opt, "vprob", None) is None
+            and getattr(self.opt, "vprob", None) is None \
+            and float(np.abs(np.asarray(self.opt.batch.P_diag)).max()) == 0.0
 
     def lagrangian_prep(self):
         """Trivial bound before any W arrives (ref. lagrangian_bounder.py:20-52)."""
@@ -47,7 +50,8 @@ class LagrangianOuterBound(OuterBoundWSpoke):
             b = exact_lagrangian_bound(self.opt.batch, self.opt.batch.prob)
             if b is not None:
                 self.update_bound(b)
-            return
+                return
+            # oracle failure: fall through to the always-valid device bound
         self.opt.solve_loop(w_on=False, prox_on=False, update=False)
         self.update_bound(self.opt.Ebound())
 
@@ -64,9 +68,12 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         if self._exact:
             from ..utils.host_oracle import exact_lagrangian_bound
             import numpy as np
-            return exact_lagrangian_bound(self.opt.batch,
-                                          self.opt.batch.prob,
-                                          np.asarray(W))
+            b = exact_lagrangian_bound(self.opt.batch,
+                                       self.opt.batch.prob,
+                                       np.asarray(W))
+            if b is not None:
+                return b
+            # oracle failure: fall through to the device bound
         self.opt.W = W
         self.opt.solve_loop(w_on=True, prox_on=False, update=False)
         return self.opt.Ebound()

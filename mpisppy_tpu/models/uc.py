@@ -61,8 +61,26 @@ def wind_scenario(scennum: int, num_hours: int, num_gens: int):
     return np.clip(level, 0.0, 0.4) * cap
 
 
+def min_up_down_times(num_gens: int):
+    """Per-generator minimum up/down times in hours: big baseload units
+    are slow to cycle (8h/8h), peakers fast (1h/1h) — the shape of the
+    egret fleet data (ref. examples/uc/uc_funcs.py via egret's
+    *_uptime/*_downtime parameters)."""
+    frac = np.linspace(0.0, 1.0, num_gens)
+    ut = np.maximum(1, np.round(8.0 * (1.0 - frac) ** 1.5)).astype(int)
+    return ut, ut.copy()
+
+
 def scenario_creator(scenario_name, num_gens=10, num_hours=24,
-                     relax_integrality=True) -> Model:
+                     relax_integrality=True, min_up_down=False,
+                     ramping=False) -> Model:
+    """``min_up_down`` adds the Rajan–Takriti turn-on inequalities
+    (sum of startups in a UT_g window <= u, and in a DT_g window <=
+    1 - u shifted) and ``ramping`` adds second-stage dispatch ramp rows
+    |p_t - p_{t-1}| <= r_g — the constraint families that make egret's
+    UC a real unit-commitment model rather than a static dispatch
+    (ref. examples/uc/uc_funcs.py egret model; both default OFF to keep
+    the benchmark instance definition stable)."""
     import re
     scennum = int(re.search(r"(\d+)$", scenario_name).group(1))
     fl = fleet(num_gens)
@@ -112,6 +130,50 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
         for t in range(T):
             Ru[t, gt(g, t)] = fl["pmax"][g]
     m.constr((Ru @ u) >= (1.0 + RESERVE_FRAC) * load - wind, name="reserve")
+
+    if min_up_down:
+        # Rajan–Takriti window inequalities on the startup indicators:
+        #   sum_{tau in (t-UT_g, t]} st[g,tau] <= u[g,t]        (min up)
+        #   sum_{tau in (t-DT_g, t]} st[g,tau] <= 1 - u[g,t-DT] (min down)
+        ut, dt_ = min_up_down_times(G)
+        Mu = np.zeros((G * T, G * T))   # window-sum of st
+        Uu = np.zeros((G * T, G * T))   # u[g,t]
+        Md = np.zeros((G * T, G * T))
+        Ud = np.zeros((G * T, G * T))
+        rhs_d = np.zeros(G * T)
+        for g in range(G):
+            for t in range(T):
+                Uu[gt(g, t), gt(g, t)] = 1.0
+                for tau in range(max(0, t - int(ut[g]) + 1), t + 1):
+                    Mu[gt(g, t), gt(g, tau)] = 1.0
+                t0 = t - int(dt_[g])
+                for tau in range(max(0, t0 + 1), t + 1):
+                    Md[gt(g, t), gt(g, tau)] = 1.0
+                if t0 >= 0:
+                    Ud[gt(g, t), gt(g, t0)] = 1.0
+                rhs_d[gt(g, t)] = 1.0
+        m.constr((Mu @ st) - (Uu @ u) <= 0.0, name="min_uptime")
+        m.constr((Md @ st) + (Ud @ u) <= rhs_d, name="min_downtime")
+
+    if ramping:
+        # ramp rows on TOTAL output pmin_g*u + p (a pure-p ramp would let
+        # commitment flips jump real output by pmin with no limit); the
+        # startup/shutdown allowance is pmin + ramp, the egret-style
+        # startup ramp relaxation
+        ramp = 0.5 * dP + 0.1 * fl["pmax"]
+        Rp = np.zeros((G * (T - 1), G * T))
+        Rut = np.zeros((G * (T - 1), G * T))
+        rr = np.zeros(G * (T - 1))
+        for g in range(G):
+            for t in range(1, T):
+                r = g * (T - 1) + (t - 1)
+                Rp[r, gt(g, t)] = 1.0
+                Rp[r, gt(g, t - 1)] = -1.0
+                Rut[r, gt(g, t)] = fl["pmin"][g]
+                Rut[r, gt(g, t - 1)] = -fl["pmin"][g]
+                rr[r] = ramp[g] + fl["pmin"][g]
+        m.constr((Rp @ p) + (Rut @ u) <= rr, name="ramp_up")
+        m.constr((Rp @ p) + (Rut @ u) >= -rr, name="ramp_down")
 
     cu = np.repeat(fl["noload"], T)
     cst = np.repeat(fl["startup"], T)

@@ -158,3 +158,49 @@ def test_battery_flow_balance_at_opt():
         x, p, q = vals["StateOfCharge"][s], vals["Charge"][s], vals["Discharge"][s]
         resid = x[1:] - x[:-1] - eff * p[:-1] + q[:-1] / eff
         assert np.max(np.abs(resid)) < 1e-3
+
+
+def test_uc_min_up_down_and_ramping():
+    """The optional Rajan-Takriti windows and ramp rows: structure, the
+    constrained optimum dominates the base one, and a fast-cycling
+    commitment violates the min-uptime rows."""
+    import numpy as np
+    from mpisppy_tpu.models import uc as ucm
+
+    kw = {"num_gens": 3, "num_hours": 8, "relax_integrality": False}
+    b0 = build_batch(ucm.scenario_creator, ucm.make_tree(2),
+                     creator_kwargs=kw)
+    b1 = build_batch(ucm.scenario_creator, ucm.make_tree(2),
+                     creator_kwargs={**kw, "min_up_down": True,
+                                     "ramping": True})
+    G, T = 3, 8
+    # min_uptime + min_downtime add 2*G*T rows; ramps add 2*G*(T-1)
+    assert b1.m == b0.m + 2 * G * T + 2 * G * (T - 1)
+
+    # a schedule that cycles every other hour violates min-uptime for
+    # the slow unit: evaluate the min_uptime rows (the G*T rows right
+    # after the base block) on a crafted commitment
+    ut, dt_ = ucm.min_up_down_times(G)
+    assert ut[0] >= 4 and ut[-1] == 1     # slow baseload, fast peaker
+    A = np.asarray(b1.A)[0]
+    n = b1.n
+    x = np.zeros(n)
+    u = np.zeros((G, T))
+    u[:, ::2] = 1.0                       # on at even hours only
+    st = np.zeros((G, T))
+    st[:, 0] = u[:, 0]
+    st[:, 1:] = np.maximum(0.0, u[:, 1:] - u[:, :-1])
+    x[:G * T] = u.reshape(-1)             # u block, g-major
+    x[G * T:2 * G * T] = st.reshape(-1)   # st block
+    up_rows = slice(b0.m, b0.m + G * T)
+    lhs = A[up_rows] @ x                  # window-sum(st) - u  per (g,t)
+    viol = lhs - np.asarray(b1.u)[0][up_rows]
+    # the slow unit's window accumulates several startups while u <= 1
+    assert viol.max() > 0.9
+    # a constant-on schedule satisfies the same rows
+    x2 = np.zeros(n)
+    x2[:G * T] = 1.0
+    st2 = np.zeros((G, T)); st2[:, 0] = 1.0
+    x2[G * T:2 * G * T] = st2.reshape(-1)
+    lhs2 = A[up_rows] @ x2
+    assert (lhs2 <= np.asarray(b1.u)[0][up_rows] + 1e-9).all()
