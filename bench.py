@@ -3,18 +3,21 @@
 Prints one JSON line per metric:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-1. uc_ph_scenario_subproblem_solves_per_sec — steady-state fused PH
+1. uc_ph_scenario_subproblem_solves_per_sec — steady-state PH
    iterations (batched ADMM solves + nonant reductions + W update) on a
-   256-scenario UC batch (10 gens x 24 h), f32 hot path with the stall
-   exit + active-set polish. The line also reports the achieved
-   post-polish max primal residual so the throughput is tied to a solve
-   quality (VERDICT r1 flagged the round-1 number as unverified).
-   Baseline (see BASELINE.md): the reference's checked-in Quartz log
-   examples/uc/quartz/10scen_nofw.baseline.out sustains ~10 subproblem
-   solves / 1.65 s = 6.06 solves/s across 30 ranks.
+   128-scenario UC batch (10 gens x 24 h) in MIXED precision (f32 bulk,
+   f64 tail + polish): solver-grade solves, with the achieved
+   post-polish max primal residual in the line so the throughput is
+   tied to a quality (VERDICT r1 flagged the round-1 number as timing
+   non-converged solves). Baseline (see BASELINE.md): the reference's
+   checked-in Quartz log examples/uc/quartz/10scen_nofw.baseline.out
+   sustains ~10 subproblem solves / 1.65 s = 6.06 solves/s on 30 ranks.
 
 2. uc1024_ph_seconds_per_iteration — the 1000-scenario north star
-   (ref. paperruns/larger_uc/1000scenarios_wind) on ONE chip; baseline
+   (ref. paperruns/larger_uc/1000scenarios_wind) on ONE chip as an f32
+   CAPACITY demonstration (the f32 loop stalls near 1e-1 relative on
+   this scaling — accuracy-critical 1000-scenario runs shard the
+   scenario axis across chips and run mixed at <=128/chip); baseline
    EXTRAPOLATED from the Quartz per-iteration trend (no checked-in
    1000-scenario log exists): ~1.65 s/iter at 10 scenarios, scenario-
    proportional => ~165 s/iter.
@@ -22,9 +25,10 @@ Prints one JSON line per metric:
 3. uc10_time_to_1pct_gap_seconds — the BASELINE.json headline: a full
    cylinder wheel (PH hub + Lagrangian outer-bound spoke + xhatshuffle
    inner-bound spoke) on INTEGER-commitment UC, wall seconds until the
-   hub first observes rel gap <= 1%. Hub runs the f32 hot path; the
-   Lagrangian spoke uses the exact host-LP oracle; the xhat spoke
-   evaluates dived integer-feasible schedules (f64-mixed). The reference
+   hub first observes rel gap <= 1%. Hub runs mixed precision (an f32
+   hub was measured to produce noise-dominated W that no Lagrangian
+   bound can use); the Lagrangian spoke uses the exact host-LP oracle;
+   the xhat spoke evaluates dived integer-feasible schedules. The reference
    crossed 1% at wall 31.59 s (10scen_nofw.baseline.out, iteration-2
    row: 0.0608%), startup included. Our number EXCLUDES jit compilation
    (a warmup wheel runs first): with a persistent compile cache, steady
@@ -70,9 +74,13 @@ def _build_ph(S, dtype, extra=None, integer=False):
 def bench_throughput():
     import numpy as np
 
-    S = 256
-    ph = _build_ph(S, jax.numpy.float32,
-                   extra={"subproblem_polish_chunk": 64})
+    S = 128
+    ph = _build_ph(S, jax.numpy.float64,
+                   extra={"subproblem_polish_chunk": 16,
+                          "subproblem_precision": "mixed",
+                          "subproblem_tail_iter": 1000,
+                          "subproblem_max_iter": 2000,
+                          "subproblem_segment": 500})
     ph.solve_loop(w_on=False, prox_on=False)
     ph.W = ph.W_new
     ph.solve_loop(w_on=True, prox_on=True)
@@ -93,8 +101,8 @@ def bench_throughput():
     print(json.dumps({
         "metric": "uc_ph_scenario_subproblem_solves_per_sec",
         "value": round(solves_per_sec, 2),
-        "unit": "solves/s/chip (f32 hot path; post-polish max pri_rel "
-                f"{pri_rel:.1e})",
+        "unit": "solves/s/chip (mixed precision, polished; post-polish "
+                f"max pri_rel {pri_rel:.1e})",
         "vs_baseline": round(solves_per_sec / baseline, 2),
     }), flush=True)
 
@@ -120,9 +128,10 @@ def bench_1024():
     print(json.dumps({
         "metric": "uc1024_ph_seconds_per_iteration",
         "value": round(sec_per_iter, 3),
-        "unit": "s/PH-iter (1024 scenarios, 1 chip, f32, post-polish max "
-                f"pri_rel {pri_rel:.1e}; baseline EXTRAPOLATED from the "
-                "10-scen Quartz trend, no checked-in 1000-scen log)",
+        "unit": "s/PH-iter (1024 scenarios, 1 chip, f32 CAPACITY demo — "
+                f"max pri_rel {pri_rel:.1e}, see bench docstring; baseline "
+                "EXTRAPOLATED from the 10-scen Quartz trend, no checked-in "
+                "1000-scen log)",
         "vs_baseline": round(165.0 / sec_per_iter, 2),
     }), flush=True)
 
@@ -138,8 +147,12 @@ def _gap_cfg(max_iterations):
         algo=AlgoConfig(default_rho=100.0, max_iterations=max_iterations,
                         convthresh=-1.0, subproblem_max_iter=2000,
                         subproblem_eps=1e-6),
-        hub_options={**UC_FAST, "dtype": "float32",
-                     "iter0_infeasibility_abort": False},
+        hub_options={**UC_FAST, "dtype": "float64",
+                     "subproblem_precision": "mixed",
+                     "subproblem_max_iter": 2000,
+                     "subproblem_tail_iter": 1200,
+                     "subproblem_segment": 500,
+                     "iter0_feas_tol": 5e-3},
         spokes=[SpokeConfig(kind="lagrangian",
                             options={"dtype": "float64",
                                      "lagrangian_exact_oracle": True}),
