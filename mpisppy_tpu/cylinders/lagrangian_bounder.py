@@ -12,42 +12,114 @@ takes the hub's *nonants* instead and computes its own x̄ and W locally
 
 from __future__ import annotations
 
+import time
+
 import jax.numpy as jnp
+import numpy as np
 
 from .spoke import OuterBoundWSpoke, OuterBoundNonantSpoke
 
 
 class LagrangianOuterBound(OuterBoundWSpoke):
-    """Two bound engines, selected by the ``lagrangian_exact_oracle``
-    option:
+    """Three bound engines, composable by options:
 
     - default: the batched on-device solve + certified dual bound
       (valid at ANY solve accuracy, tight once duals converge);
-    - exact oracle: per-scenario host HiGHS LPs (utils/host_oracle) —
-      exact L(W), the analog of the reference's spoke renting a CPU
-      simplex per scenario (ref. lagrangian_bounder.py:5-87). Linear
-      objectives only; the spoke is asynchronous so host latency never
-      blocks the hub."""
+    - ``lagrangian_exact_oracle``: per-scenario host HiGHS LPs
+      (utils/host_oracle) — exact L(W) of the LP relaxation, the analog
+      of the reference's spoke renting a CPU simplex per scenario (ref.
+      lagrangian_bounder.py:5-87). Fast (~10 ms/scenario) but floored
+      at the instance's LP integrality gap.
+    - ``lagrangian_mip_oracle``: per-scenario host HiGHS **MILPs** with
+      W on — the true Lagrangian dual function, matching the
+      reference's MIP subproblem solves (ref.
+      lagrangian_bounder.py:54-56 → phbase.py:947-949) that carry its
+      UC gaps to 0.026-0.073% where LP bounds stall near ~1%. Each
+      scenario value is the B&B dual bound (valid at any time_limit /
+      mip_rel_gap stop). Refreshes run at ``lagrangian_mip_cadence``
+      seconds (default 0: back-to-back) on the newest projected W,
+      through a subprocess pool that overlaps the hub's device work and
+      aborts on the hub's kill signal mid-refresh.
+
+    Linear objectives only for both oracles; quadratic models and
+    variable-probability runs fall back to the certified device bound.
+    The spoke is asynchronous, so host latency never blocks the hub.
+    """
     converger_spoke_char = "L"
 
-    @property
-    def _exact(self):
-        # the host oracle evaluates sum_s p_s (min f_s + W_s x), which is
-        # a valid outer bound only on the sum_s p_s W_s = 0 manifold and
-        # only for LINEAR objectives — under VARIABLE probabilities the
-        # engine's W lives on the vprob-weighted manifold, and quadratic
-        # models have no host LP form, so both fall back silently to the
-        # (vprob-aware, quadratic-capable) certified device bound
-        import numpy as np
-        return bool(self.options.get("lagrangian_exact_oracle", False)) \
-            and getattr(self.opt, "vprob", None) is None \
-            and float(np.abs(np.asarray(self.opt.batch.P_diag)).max()) == 0.0
+    def __init__(self, spbase_object, options=None, trace_prefix=None):
+        super().__init__(spbase_object, options, trace_prefix)
+        # the oracle-eligibility test re-materialized P_diag to host on
+        # every sync when it was a property (ADVICE r2) — it is static,
+        # so decide once
+        self._linear = getattr(self.opt, "vprob", None) is None and \
+            float(np.abs(np.asarray(self.opt.batch.P_diag)).max()) == 0.0
+        self._exact = bool(self.options.get("lagrangian_exact_oracle",
+                                            False)) and self._linear
+        self._mip = bool(self.options.get("lagrangian_mip_oracle",
+                                          False)) and self._linear
+        self._mip_tl = float(self.options.get("lagrangian_mip_time_limit",
+                                              10.0))
+        self._mip_gap = float(self.options.get("lagrangian_mip_gap", 1e-4))
+        self._mip_cadence = float(self.options.get("lagrangian_mip_cadence",
+                                                   0.0))
+        # one degenerate scenario LP must not stall the refresh forever
+        # (ADVICE r2): timeouts surface as ok=False → device fallback
+        self._lp_tl = self.options.get("lagrangian_lp_time_limit", 60.0)
+        self._pool = None
+        self._last_mip_at = -float("inf")
+        self._last_mip_ok = True
+
+    def _oracle(self):
+        if self._pool is None:
+            from ..utils.host_oracle import OraclePool
+            self._pool = OraclePool(
+                self.opt.batch,
+                n_workers=self.options.get("lagrangian_oracle_workers"))
+        return self._pool
+
+    def _oracle_bound(self, W=None, **kw):
+        """Oracle call with the spoke's failure contract: ANY oracle
+        problem (worker subprocess death included) degrades to None so
+        the caller falls back to the device bound — a bound spoke must
+        never crash the wheel over a host solver hiccup."""
+        try:
+            return self._oracle().lagrangian_bound(
+                self.opt.batch.prob, W, kill_check=self.killed, **kw)
+        except Exception:
+            return None
+
+    def _project_W(self, W_flat):
+        # Project the received W onto the dual-feasible manifold
+        # sum_s p_s W_s = 0 per (node, slot) by removing its p-weighted
+        # node mean. PH-generated W satisfies this in exact arithmetic,
+        # but the hub may run a lower precision (an f32 hot loop leaves
+        # O(1e-4·scale) mass), and the Lagrangian bound is only a valid
+        # outer bound on that manifold. The projection runs in HOST
+        # float64 regardless of engine dtype: the bound certificate's
+        # precision is set by the projector, and an f32 projection
+        # would leave an O(eps_f32·|W|) off-manifold residual that the
+        # f64/MIP oracle bounds (1e-4-level tightness) cannot absorb.
+        if getattr(self.opt, "vprob", None) is not None:
+            # variable probabilities: the manifold is vprob-weighted;
+            # oracles are disabled here, so the engine projection (same
+            # precision as the device bound it feeds) is the right one
+            W = jnp.asarray(W_flat, self.opt.dtype)
+            return W - self.opt.compute_xbar(W)
+        b = self.opt.batch
+        W = np.asarray(W_flat, dtype=np.float64).reshape(b.S, b.K).copy()
+        p = np.asarray(b.prob, dtype=np.float64)
+        for t, sl in enumerate(b.stage_slot_slices):
+            B = np.asarray(b.tree.membership(t + 1), dtype=np.float64)
+            pnode = B.T @ p
+            num = B.T @ (p[:, None] * W[:, sl])
+            W[:, sl] -= B @ (num / pnode[:, None])
+        return W
 
     def lagrangian_prep(self):
         """Trivial bound before any W arrives (ref. lagrangian_bounder.py:20-52)."""
-        if self._exact:
-            from ..utils.host_oracle import exact_lagrangian_bound
-            b = exact_lagrangian_bound(self.opt.batch, self.opt.batch.prob)
+        if self._exact or self._mip:
+            b = self._oracle_bound(time_limit=self._lp_tl)
             if b is not None:
                 self.update_bound(b)
                 return
@@ -55,28 +127,29 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         self.opt.solve_loop(w_on=False, prox_on=False, update=False)
         self.update_bound(self.opt.Ebound())
 
-    def _bound_from_Ws(self, W_flat):
-        # Project the received W onto the dual-feasible manifold
-        # sum_s p_s W_s = 0 per (node, slot) by removing its p-weighted
-        # node mean. PH-generated W satisfies this in exact arithmetic,
-        # but the hub may run a lower precision (an f32 hot loop leaves
-        # O(1e-4·scale) mass), and the Lagrangian bound is only a valid
-        # outer bound on that manifold — the projection makes the
-        # certificate exact at THIS engine's precision.
-        W = jnp.asarray(W_flat, self.opt.dtype)
-        W = W - self.opt.compute_xbar(W)
+    def _fast_bound(self, W):
+        """LP-relaxation bound at W: exact host LP oracle when enabled,
+        else the certified device bound."""
         if self._exact:
-            from ..utils.host_oracle import exact_lagrangian_bound
-            import numpy as np
-            b = exact_lagrangian_bound(self.opt.batch,
-                                       self.opt.batch.prob,
-                                       np.asarray(W))
+            b = self._oracle_bound(np.asarray(W), time_limit=self._lp_tl)
             if b is not None:
                 return b
+            if self.killed():
+                return None
             # oracle failure: fall through to the device bound
-        self.opt.W = W
+        self.opt.W = jnp.asarray(W, self.opt.dtype)
         self.opt.solve_loop(w_on=True, prox_on=False, update=False)
         return self.opt.Ebound()
+
+    def _mip_refresh(self, W):
+        """MIP-tight L(W): expensive (B&B per scenario), so it runs on
+        the newest W at the configured cadence and aborts on kill."""
+        self._last_mip_at = time.monotonic()
+        b = self._oracle_bound(np.asarray(W), milp=True,
+                               time_limit=self._mip_tl,
+                               mip_gap=self._mip_gap)
+        self._last_mip_ok = b is not None
+        return b
 
     def main(self):
         self.lagrangian_prep()
@@ -85,9 +158,27 @@ class LagrangianOuterBound(OuterBoundWSpoke):
             if not fresh or values is None:
                 continue
             W, _ = self.unpack_hub(values)
-            bound = self._bound_from_Ws(W)
-            if bound is not None:       # None: an oracle solve failed
-                self.update_bound(bound)
+            W = self._project_W(W)
+            if not (self._mip and self._mip_cadence == 0.0
+                    and self._last_mip_ok):
+                # with back-to-back SUCCEEDING MIP refreshes the LP
+                # crawl adds nothing (every published bound is
+                # superseded immediately); but if the last refresh
+                # failed, the cheap bound must keep flowing or the
+                # published bound freezes at its pre-failure value
+                bound = self._fast_bound(W)
+                if bound is not None:
+                    self.update_bound(bound)
+            if self._mip and (time.monotonic() - self._last_mip_at
+                              >= self._mip_cadence):
+                bound = self._mip_refresh(W)
+                if bound is not None:   # None: kill/solve failure
+                    self.update_bound(bound)
+
+    def finalize(self):
+        if self._pool is not None:
+            self._pool.close()
+        return super().finalize()
 
 
 class LagrangerOuterBound(OuterBoundNonantSpoke):

@@ -61,6 +61,15 @@ class Spoke(SPCommunicator):
         if now - self._last_kill_check < SPOKE_SLEEP_TIME:
             time.sleep(SPOKE_SLEEP_TIME)
         self._last_kill_check = time.monotonic()
+        return self.killed()
+
+    def killed(self) -> bool:
+        """Non-sleeping kill probe for use INSIDE long spoke work
+        (candidate loops, oracle refreshes): one atomic id read, no
+        rate limiting. Long-running spoke steps must poll this so a
+        terminating wheel never waits out a mid-flight refresh
+        (the reference's kill window is likewise checked between
+        subproblem solves, ref. spoke.py:101-111)."""
         return self.hub_window.read_id() == Window.KILL
 
     def main(self):

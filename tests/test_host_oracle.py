@@ -1,0 +1,139 @@
+"""Host oracle pool: pooled/inline equivalence, MIP-tight Lagrangian
+bounds, and kill-check abort.
+
+The MIP oracle is the analog of the reference's Lagrangian spoke solving
+MIP subproblems with W on (ref. mpisppy/cylinders/lagrangian_bounder.py:
+54-56 → phbase.py:947-949) — the mechanism that carries its UC gaps past
+the LP integrality-gap floor (BASELINE.md 0.026-0.073%).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mpisppy_tpu.ir.batch import build_batch
+from mpisppy_tpu.core.ph import PH, PHBase
+from mpisppy_tpu.models import uc
+from mpisppy_tpu.utils.host_oracle import OraclePool
+
+
+def _uc_batch(S=3, G=3, T=6, integer=True):
+    return build_batch(uc.scenario_creator, uc.make_tree(S),
+                       creator_kwargs={"num_gens": G, "num_hours": T,
+                                       "relax_integrality": not integer})
+
+
+@pytest.fixture(scope="module")
+def ph_state():
+    """Integer UC batch + PH-converged projected W + the integer EF
+    optimum (host MILP of the EF with nonant equality via shared
+    columns)."""
+    from mpisppy_tpu.core.ef import ExtensiveForm
+
+    b = _uc_batch()
+    ph = PH(b, {"defaultPHrho": 50.0, "PHIterLimit": 20,
+                "convthresh": -1.0, "subproblem_max_iter": 1500,
+                "subproblem_eps": 1e-7})
+    ph.ph_main(finalize=False)
+    W = np.asarray(ph.W - ph.compute_xbar(ph.W))
+    ef_obj, _ = ExtensiveForm(_uc_batch()).solve_extensive_form(
+        integer=True, time_limit=60.0)
+    return b, W, ef_obj
+
+
+def test_pool_matches_inline_lp(ph_state):
+    b, W, _ = ph_state
+    inline = OraclePool(b, n_workers=0)
+    pooled = OraclePool(b, n_workers=2)
+    try:
+        vi, oki, _ = inline.scenario_values(W)
+        vp, okp, _ = pooled.scenario_values(W)
+        assert oki.all() and okp.all()
+        np.testing.assert_allclose(vi, vp, rtol=1e-9)
+    finally:
+        pooled.close()
+
+
+def test_mip_bound_between_lp_and_ef(ph_state):
+    """LP Lagrangian <= MIP Lagrangian <= integer EF optimum, and the
+    MIP wait-and-see bound dominates the LP wait-and-see bound."""
+    b, W, ef_obj = ph_state
+    pool = OraclePool(b, n_workers=0)
+    lp = pool.lagrangian_bound(b.prob, W)
+    mip = pool.lagrangian_bound(b.prob, W, milp=True, time_limit=30.0,
+                                mip_gap=1e-6)
+    assert lp is not None and mip is not None
+    assert mip >= lp - 1e-6 * abs(lp)
+    assert mip <= ef_obj + 1e-6 * abs(ef_obj)
+    lp_ws = pool.lagrangian_bound(b.prob)
+    mip_ws = pool.lagrangian_bound(b.prob, milp=True, time_limit=30.0,
+                                   mip_gap=1e-6)
+    assert mip_ws >= lp_ws - 1e-6 * abs(lp_ws)
+
+
+def test_mip_values_valid_at_loose_gap(ph_state):
+    """A gap-limited MILP stop still returns certified lower bounds
+    (HiGHS dual bound), never primal incumbents."""
+    b, W, ef_obj = ph_state
+    pool = OraclePool(b, n_workers=0)
+    tight, ok_t, _ = pool.scenario_values(W, milp=True, time_limit=30.0,
+                                          mip_gap=1e-7)
+    loose, ok_l, _ = pool.scenario_values(W, milp=True, time_limit=30.0,
+                                          mip_gap=5e-2)
+    assert ok_t.all() and ok_l.all()
+    # loose dual bounds sit at or below the (near-)exact scenario values
+    assert (loose <= tight + 1e-5 * np.abs(tight)).all()
+
+
+def test_kill_check_aborts_refresh():
+    b = _uc_batch(S=4)
+    pool = OraclePool(b, n_workers=0)
+    calls = []
+
+    def killed():
+        calls.append(1)
+        return len(calls) > 1      # let one scenario through, then kill
+
+    res = pool.scenario_values(milp=True, time_limit=30.0,
+                               kill_check=killed)
+    assert res is None
+    assert pool.lagrangian_bound(b.prob, milp=True,
+                                 kill_check=lambda: True) is None
+
+
+def test_quadratic_objective_rejected():
+    from mpisppy_tpu.models import farmer
+
+    b = build_batch(farmer.scenario_creator, farmer.make_tree(3))
+    b.P_diag[:] = 1.0
+    with pytest.raises(ValueError):
+        OraclePool(b)
+
+
+def test_spoke_mip_oracle_publishes_tighter_bound(ph_state):
+    """LagrangianOuterBound with the MIP oracle: wired to a hand-driven
+    hub window, a fresh W triggers an LP publish then a MIP refresh that
+    can only raise the bound; both stay <= the EF optimum."""
+    from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
+    from mpisppy_tpu.cylinders.spcommunicator import Window
+
+    b, W, ef_obj = ph_state
+    opt = PHBase(b, {"defaultPHrho": 50.0, "subproblem_max_iter": 1500,
+                     "subproblem_eps": 1e-7})
+    sp = LagrangianOuterBound(opt, options={
+        "lagrangian_exact_oracle": True,
+        "lagrangian_mip_oracle": True,
+        "lagrangian_mip_time_limit": 30.0,
+        "lagrangian_mip_gap": 1e-6,
+        "lagrangian_oracle_workers": 0,
+    })
+    sp.hub_window = Window(sp.remote_window_length())
+    sp.my_window = Window(sp.local_window_length())
+    try:
+        lp_bound = sp._fast_bound(jnp.asarray(W, opt.dtype))
+        mip_bound = sp._mip_refresh(jnp.asarray(W, opt.dtype))
+        assert mip_bound is not None
+        assert mip_bound >= lp_bound - 1e-6 * abs(lp_bound)
+        assert mip_bound <= ef_obj + 1e-6 * abs(ef_obj)
+    finally:
+        sp.finalize()
