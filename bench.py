@@ -153,68 +153,78 @@ def _gap_cfg(max_iterations):
                      "subproblem_tail_iter": 1200,
                      "subproblem_segment": 500,
                      "iter0_feas_tol": 5e-3},
+        # wheel = PH hub (device) + MIP-tight Lagrangian outer spoke +
+        # host EF-MIP incumbent spoke — 3 cylinders, the shape of the
+        # reference's 10scen_nofw wheel (hub + lagrangian + xhat). Both
+        # bound spokes are host-side (oracle subprocesses), so the hub
+        # keeps the chip to itself; the Lagrangian spoke warm-starts at
+        # the LP-EF dual optimum W* and MIP-refreshes there, which is
+        # where the reference's bound lands only after ~100 Gurobi
+        # iterations (BASELINE.md trajectory).
         spokes=[SpokeConfig(kind="lagrangian",
                             options={"dtype": "float64",
-                                     "lagrangian_exact_oracle": True}),
-                SpokeConfig(kind="xhatshuffle",
+                                     "lagrangian_exact_oracle": True,
+                                     "lagrangian_mip_oracle": True,
+                                     "lagrangian_mip_time_limit": 10.0,
+                                     "lagrangian_mip_gap": 1e-4}),
+                SpokeConfig(kind="efmip",
                             options={"dtype": "float64",
-                                     "subproblem_precision": "mixed",
-                                     "subproblem_max_iter": 1500,
-                                     "subproblem_tail_iter": 400,
-                                     "subproblem_stall_rel": 1e-3,
-                                     "subproblem_segment": 400,
-                                     "xhat_feas_tol": 1e-3})],
-        rel_gap=0.01)
+                                     "efmip_time_limit": 120.0,
+                                     "efmip_gap": 1e-4})],
+        rel_gap=0.005)
 
 
 def bench_time_to_gap():
-    import numpy as np
     from mpisppy_tpu.utils import vanilla
     from mpisppy_tpu.utils.sputils import spin_the_wheel
 
     # SEQUENTIAL warmup — compiles every device program the wheel will
-    # use (hub f32 iter0/hot modes; xhat dive + fixed-mode incumbent
-    # eval) without racing spoke threads against the compiler; the
-    # exact-oracle Lagrangian spoke has no device programs
-    hdw, sdsw = vanilla.wheel_dicts(_gap_cfg(max_iterations=3))
+    # use (hub mixed-precision iter0/hot modes) without racing spoke
+    # threads against the compiler; the oracle spokes run on host
+    hdw, _ = vanilla.wheel_dicts(_gap_cfg(max_iterations=3))
     hub_opt = hdw["opt_class"](**hdw["opt_kwargs"])
     hub_opt.solve_loop(w_on=False, prox_on=False)
     hub_opt.W = hub_opt.W_new
     hub_opt.solve_loop(w_on=True, prox_on=True)
-    xh = sdsw[1]["opt_class"](**sdsw[1]["opt_kwargs"])
-    cands, feas = xh.dive_nonant_candidates(
-        np.asarray(hub_opt.xbar, np.float64))
-    xh.calculate_incumbent(cands[0])
-    del hub_opt, xh
+    del hub_opt
 
     # timed wheel on fresh engines (same shapes -> cached compiles)
     hd, sds = vanilla.wheel_dicts(_gap_cfg(max_iterations=250))
+    hd["hub_kwargs"]["options"]["gap_marks"] = (0.01, 0.005)
     t0 = time.perf_counter()
     res = spin_the_wheel(hd, sds)
     t_end = time.perf_counter()
-    reached = getattr(res.hub, "gap_reached_at", None)
     _, rel_gap = res.gap()
-    if reached is not None:
-        t_gap = reached - t0
-        vs = round(31.59 / t_gap, 2)
-        metric = "uc10_time_to_1pct_gap_seconds"
-    else:
-        # DID NOT FINISH: report under a distinct metric name so tooling
-        # never reads a wall-clock-at-iteration-limit as a time-to-gap
-        t_gap = t_end - t0
-        vs = 0.0
-        metric = "uc10_time_to_1pct_gap_DNF_wall_seconds"
-    print(json.dumps({
-        "metric": metric,
-        "value": round(t_gap, 1),
-        "unit": "s to rel gap <= 1% (PH hub f32 + exact-oracle Lagrangian "
-                "+ dived-xhat spokes, integer UC, compile excluded via "
-                f"warmup wheel; final gap {100 * rel_gap:.3f}%, outer "
-                f"{res.best_outer_bound:.1f}, inner "
-                f"{res.best_inner_bound:.1f}; reference crossed 1% at "
-                "31.59 s wall incl. its 29 s startup)",
-        "vs_baseline": vs,
-    }), flush=True)
+    marks = res.hub.gap_mark_times
+    tail = (f"final gap {100 * rel_gap:.3f}%, outer "
+            f"{res.best_outer_bound:.1f}, inner "
+            f"{res.best_inner_bound:.1f}; reference crossed both 1% and "
+            "0.5% at 31.59 s wall — its first Lagrangian bound was "
+            "already 0.061% (10scen_nofw.baseline.out iteration-2 row)")
+    for mark, name in ((0.01, "uc10_time_to_1pct_gap_seconds"),
+                       (0.005, "uc10_time_to_halfpct_gap_seconds")):
+        reached = marks.get(mark)
+        if reached is not None:
+            t_gap = reached - t0
+            vs = round(31.59 / t_gap, 2)
+            metric = name
+        else:
+            # DID NOT FINISH: distinct metric name so tooling never
+            # reads a wall-clock-at-iteration-limit as a time-to-gap
+            t_gap = t_end - t0
+            vs = 0.0
+            metric = name.replace("_seconds", "_DNF_wall_seconds")
+        print(json.dumps({
+            "metric": metric,
+            "value": round(t_gap, 1),
+            "unit": f"s to rel gap <= {100 * mark:g}% (PH hub mixed-"
+                    "precision on device + MIP-tight Lagrangian spoke "
+                    "(LP-EF dual warm start, host HiGHS oracle "
+                    "subprocesses) + host EF-MIP incumbent spoke, "
+                    "integer UC, compile excluded via warmup wheel; "
+                    + tail + ")",
+            "vs_baseline": vs,
+        }), flush=True)
 
 
 def main():

@@ -601,6 +601,19 @@ class PH(PHBase):
             self.converger = self.converger_cls(self)
         global_toc(f"PH iter 0: trivial bound = {self.trivial_bound:.4f}",
                    self.verbose)
+        if self.spcomm is not None:
+            # iter-0 sync: push the first W / nonants and collect any
+            # bounds the host-oracle spokes produced while the device
+            # ran iter 0. The reference's hub first syncs inside
+            # iterk_loop (ref. phbase.py:1522), an artifact of its
+            # solver-bound startup; with asynchronous host bound spokes
+            # a whole wheel can be within tolerance before iter 1.
+            self.spcomm.sync()
+            if self.spcomm.is_converged():
+                global_toc("PH iter 0: hub termination", self.verbose)
+                if finalize:
+                    return self.post_loops()
+                return self.conv
 
         # Iter k loop (ref. phbase.py:1472 iterk_loop)
         for it in range(1, self.max_iterations + 1):

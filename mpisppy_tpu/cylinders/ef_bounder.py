@@ -1,0 +1,69 @@
+"""Host EF-MIP incumbent spoke.
+
+Solves the full equality-row extensive form as ONE host MILP (HiGHS B&B
+in a kill-abortable oracle subprocess) and publishes the incumbent
+objective as an inner bound, keeping the integer-feasible first-stage
+plan for ``finalize``. The direct analog of the reference handing the
+monolithic EF to a rented solver (ref. mpisppy/opt/ef.py:61 driving
+phbase.py:1307 SolverFactory) — run as a *cylinder* so the wheel gets
+exact-incumbent quality at instance scales where the EF fits a host
+B&B, while the dive-based x̂ spokes carry the scales where it doesn't
+(the EF of a 1000-scenario batch is beyond any single B&B run's time
+budget; the batched device dive is not).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import InnerBoundSpoke
+
+
+class EFMipInnerBound(InnerBoundSpoke):
+    """Options: ``efmip_time_limit`` (s, default 180), ``efmip_gap``
+    (HiGHS mip_rel_gap, default 1e-4), ``efmip_workers`` (oracle pool
+    size; the EF is one problem, so >1 never helps — default 1
+    subprocess). Keep the subprocess default in wheels: inline mode
+    (0) cannot abort the single B&B solve on the kill signal, so a
+    fast-terminating wheel would wait out the full time limit and drop
+    this spoke's incumbent at the join deadline."""
+
+    converger_spoke_char = "E"
+
+    def __init__(self, spbase_object, options=None, trace_prefix=None):
+        super().__init__(spbase_object, options, trace_prefix)
+        self.best_xhat = None
+        self._pool = None
+
+    def main(self):
+        from ..utils.host_oracle import ef_mip_pool
+
+        b = self.opt.batch
+        try:
+            self._pool = ef_mip_pool(
+                b, n_workers=self.options.get("efmip_workers", 1))
+            res = self._pool.scenario_values(
+                milp=True,
+                time_limit=float(self.options.get("efmip_time_limit",
+                                                  180.0)),
+                mip_gap=float(self.options.get("efmip_gap", 1e-4)),
+                kill_check=self.killed, return_x=True)
+        except Exception:
+            res = None   # host solver hiccup: publish nothing, idle out
+        if res is not None and res[3][0] is not None:
+            obj, x_ef = res[3][0]
+            n = b.n
+            idx = np.asarray(b.nonant_idx)
+            xhat = np.stack([x_ef[s * n:(s + 1) * n][idx]
+                             for s in range(b.S)])
+            self.best_xhat = self.opt.round_nonants(xhat)
+            self.update_bound(obj)
+        # solved (or failed): idle on the kill signal like a looper
+        # whose candidate stream is exhausted
+        while not self.got_kill_signal():
+            pass
+
+    def finalize(self):
+        if self._pool is not None:
+            self._pool.close()
+        return self.bound, self.best_xhat

@@ -29,6 +29,7 @@ class Hub(SPCommunicator):
         self._spoke_last_ids = [0] * len(self.spokes)
         self.latest_ib_char = " "
         self.latest_ob_char = " "
+        self.gap_mark_times = {}
         self._print_rows = 0
         self.extra_checks = bool((options or {}).get("extra_checks", False))
 
@@ -107,15 +108,21 @@ class Hub(SPCommunicator):
         return abs_gap, rel_gap
 
     def determine_termination(self) -> bool:
+        import time
+
         abs_gap, rel_gap = self.compute_gaps()
+        # rel-gap milestone stamps: the "gap_marks" hub option lists
+        # thresholds whose first crossing instant is recorded in
+        # self.gap_mark_times (time-to-gap benchmarks read these;
+        # perf_counter, not wall time) without affecting termination
+        for mark in self.options.get("gap_marks", ()):
+            if rel_gap <= mark and mark not in self.gap_mark_times:
+                self.gap_mark_times[mark] = time.perf_counter()
         abs_opt = self.options.get("abs_gap", None)
         rel_opt = self.options.get("rel_gap", None)
         hit = (abs_opt is not None and abs_gap <= abs_opt) or \
             (rel_opt is not None and rel_gap <= rel_opt)
         if hit and not hasattr(self, "gap_reached_at"):
-            # first instant the gap target was observed (time-to-gap
-            # benchmarks read this; perf_counter, not wall time)
-            import time
             self.gap_reached_at = time.perf_counter()
         return hit
 

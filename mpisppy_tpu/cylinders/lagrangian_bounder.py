@@ -66,7 +66,16 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         # one degenerate scenario LP must not stall the refresh forever
         # (ADVICE r2): timeouts surface as ok=False → device fallback
         self._lp_tl = self.options.get("lagrangian_lp_time_limit", 60.0)
+        # LP-EF dual warm start (utils/host_oracle.solve_lp_ef): one
+        # host LP solve puts the spoke AT the LP-relaxation Lagrangian
+        # maximum before the hub's first W arrives — W convergence
+        # stops being the bound bottleneck. Inline (not abortable), so
+        # very large batches can disable it.
+        self._warm = bool(self.options.get("lagrangian_lp_ef_warmstart",
+                                           True)) \
+            and (self._exact or self._mip)
         self._pool = None
+        self._projector = None
         self._last_mip_at = -float("inf")
         self._last_mip_ok = True
 
@@ -96,7 +105,8 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         # but the hub may run a lower precision (an f32 hot loop leaves
         # O(1e-4·scale) mass), and the Lagrangian bound is only a valid
         # outer bound on that manifold. The projection runs in HOST
-        # float64 regardless of engine dtype: the bound certificate's
+        # float64 regardless of engine dtype (host_oracle's shared,
+        # membership-precomputing projector): the bound certificate's
         # precision is set by the projector, and an f32 projection
         # would leave an O(eps_f32·|W|) off-manifold residual that the
         # f64/MIP oracle bounds (1e-4-level tightness) cannot absorb.
@@ -106,18 +116,32 @@ class LagrangianOuterBound(OuterBoundWSpoke):
             # precision as the device bound it feeds) is the right one
             W = jnp.asarray(W_flat, self.opt.dtype)
             return W - self.opt.compute_xbar(W)
-        b = self.opt.batch
-        W = np.asarray(W_flat, dtype=np.float64).reshape(b.S, b.K).copy()
-        p = np.asarray(b.prob, dtype=np.float64)
-        for t, sl in enumerate(b.stage_slot_slices):
-            B = np.asarray(b.tree.membership(t + 1), dtype=np.float64)
-            pnode = B.T @ p
-            num = B.T @ (p[:, None] * W[:, sl])
-            W[:, sl] -= B @ (num / pnode[:, None])
-        return W
+        if self._projector is None:
+            from ..utils.host_oracle import make_w_projector
+            self._projector = make_w_projector(self.opt.batch)
+        return self._projector(W_flat)
 
     def lagrangian_prep(self):
-        """Trivial bound before any W arrives (ref. lagrangian_bounder.py:20-52)."""
+        """Bound before any W arrives (ref. lagrangian_bounder.py:20-52
+        computes the trivial W=0 bound here). With the LP-EF warm start
+        the prep bound is the LP-relaxation OPTIMUM (its dual W* is the
+        LP-Lagrangian maximizer), and the MIP oracle refreshed at W*
+        immediately lands near the full Lagrangian dual — the W=0
+        trivial bound is strictly dominated and skipped."""
+        if self._warm:
+            try:
+                from ..utils.host_oracle import solve_lp_ef
+                lp_obj, W_star = solve_lp_ef(self.opt.batch)
+            except Exception:
+                lp_obj, W_star = None, None
+            if W_star is not None:
+                self.update_bound(lp_obj)
+                if self._mip:
+                    b = self._mip_refresh(W_star)
+                    if b is not None:
+                        self.update_bound(b)
+                return
+            # LP-EF failure: fall through to the W=0 prep bound
         if self._exact or self._mip:
             b = self._oracle_bound(time_limit=self._lp_tl)
             if b is not None:

@@ -37,11 +37,14 @@ def read_msg(f):
     return pickle.loads(data)
 
 
-def write_msg(f, obj):
-    b = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+def write_frame(f, b: bytes):
     f.write(struct.pack("<Q", len(b)))
     f.write(b)
     f.flush()
+
+
+def write_msg(f, obj):
+    write_frame(f, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
 
 def init_worker(payload: dict) -> dict:
     """Build a solver state dict from the static payload, pre-seeding
@@ -57,7 +60,10 @@ def init_worker(payload: dict) -> dict:
 
     state = dict(payload)
     A = payload["A"]
-    if A.ndim == 2:
+    if sparse.issparse(A):
+        state["A_csr"] = sparse.csr_matrix(A)
+        state["A_shared"] = True
+    elif A.ndim == 2:
         state["A_csr"] = sparse.csr_matrix(A)
         state["A_shared"] = True
     else:
@@ -83,18 +89,22 @@ def solve_scenario(state: dict, task):
     """Solve one scenario LP/MILP: min q·x s.t. l<=Ax<=u, lb<=x<=ub
     (+ integrality when milp=True).
 
-    task = (s, q, milp, time_limit, mip_gap).
-    Returns (s, value, ok, optimal):
+    task = (s, q, milp, time_limit, mip_gap[, want_x]).
+    Returns (s, value, ok, optimal, primal):
       value — a certified LOWER bound on the scenario minimum (the LP
         optimum, or HiGHS's B&B dual bound for MILPs — valid even when
         the solve stops on time_limit/mip_gap);
       ok — value is a usable finite bound;
       optimal — the solve finished proven-optimal (so re-solving with a
-        tighter budget cannot improve it).
+        tighter budget cannot improve it);
+      primal — (obj, x) of the solver's feasible point when want_x and
+        one exists, else None. For MILPs obj is the INCUMBENT objective
+        (an upper bound), distinct from the dual `value`.
     """
     from scipy.optimize import Bounds, LinearConstraint, milp as _milp
 
-    s, q, want_milp, time_limit, mip_gap = task
+    s, q, want_milp, time_limit, mip_gap = task[:5]
+    want_x = bool(task[5]) if len(task) > 5 else False
     integrality = state["integrality"] if want_milp else None
     opts = {"presolve": True}
     if time_limit is not None:
@@ -110,15 +120,17 @@ def solve_scenario(state: dict, task):
                      else np.zeros(q.shape[0], dtype=np.uint8)),
         options=opts,
     )
+    primal = (float(res.fun), np.asarray(res.x)) \
+        if want_x and res.x is not None else None
     if want_milp:
         # HiGHS's dual (best) bound is a valid lower bound at ANY stop
         # reason; -inf / None means nothing was proven
         val = res.mip_dual_bound
         ok = val is not None and np.isfinite(val)
         optimal = bool(res.status == 0)
-        return s, (float(val) if ok else -np.inf), ok, optimal
+        return s, (float(val) if ok else -np.inf), ok, optimal, primal
     ok = bool(res.status == 0 and res.x is not None)
-    return s, (float(res.fun) if ok else -np.inf), ok, ok
+    return s, (float(res.fun) if ok else -np.inf), ok, ok, primal
 
 
 def main():

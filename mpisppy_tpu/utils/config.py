@@ -25,7 +25,7 @@ KNOWN_MODELS = ("farmer", "sizes", "sslp", "netdes", "hydro", "uc",
                 "battery", "ccopf")
 KNOWN_SPOKES = ("lagrangian", "lagranger", "xhatshuffle", "xhatlooper",
                 "xhatspecific", "xhatlshaped", "fwph", "slamup",
-                "slamdown", "cross_scenario")
+                "slamdown", "cross_scenario", "efmip")
 KNOWN_HUBS = ("ph", "aph", "lshaped")
 
 

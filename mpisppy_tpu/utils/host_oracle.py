@@ -52,7 +52,9 @@ class _ProcWorker:
     module's docstring for why this is a subprocess, not
     multiprocessing."""
 
-    def __init__(self, payload):
+    def __init__(self, payload_bytes):
+        """``payload_bytes``: the PRE-PICKLED static payload — pickled
+        once per pool, not per worker (multi-MB for large batches)."""
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
@@ -60,7 +62,7 @@ class _ProcWorker:
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "mpisppy_tpu.utils._oracle_worker"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
-        _oracle_worker.write_msg(self.proc.stdin, payload)
+        _oracle_worker.write_frame(self.proc.stdin, payload_bytes)
 
     def solve(self, task):
         _oracle_worker.write_msg(self.proc.stdin, task)
@@ -91,10 +93,6 @@ class OraclePool:
     def __init__(self, batch, n_workers=None):
         if np.abs(np.asarray(batch.P_diag)).max() > 0:
             raise ValueError("host oracle supports linear objectives only")
-        self.S = int(batch.S)
-        self.c = np.asarray(batch.c, dtype=np.float64)
-        self.c0 = np.asarray(batch.c0, dtype=np.float64)
-        self.nonant_idx = np.asarray(batch.nonant_idx)
         A = np.asarray(batch.A, dtype=np.float64)
         if A.ndim == 3 and all(np.array_equal(A[s], A[0])
                                for s in range(1, A.shape[0])):
@@ -102,13 +100,39 @@ class OraclePool:
             # every shipped model family): ship ONE matrix, not S copies
             # ((S,m,n) at S=1024 would be gigabytes of payload)
             A = A[0]
+        self._init_arrays(
+            A, np.asarray(batch.l, dtype=np.float64),
+            np.asarray(batch.u, dtype=np.float64),
+            np.asarray(batch.lb, dtype=np.float64),
+            np.asarray(batch.ub, dtype=np.float64),
+            np.asarray(batch.integer, dtype=np.uint8),
+            np.asarray(batch.c, dtype=np.float64),
+            np.asarray(batch.c0, dtype=np.float64),
+            np.asarray(batch.nonant_idx), n_workers)
+
+    @classmethod
+    def from_arrays(cls, A, l, u, lb, ub, integrality, c, c0,
+                    nonant_idx=None, n_workers=None):
+        """Pool over explicit standard-form arrays (no ScenarioBatch) —
+        e.g. ONE extensive-form problem as a batch of one. ``A`` may be
+        scipy-sparse (shared) or dense (2-D shared / 3-D per-row)."""
+        self = cls.__new__(cls)
+        self._init_arrays(A, np.atleast_2d(l), np.atleast_2d(u),
+                          np.atleast_2d(lb), np.atleast_2d(ub),
+                          np.asarray(integrality, dtype=np.uint8),
+                          np.atleast_2d(c), np.atleast_1d(c0),
+                          nonant_idx, n_workers)
+        return self
+
+    def _init_arrays(self, A, l, u, lb, ub, integrality, c, c0,
+                     nonant_idx, n_workers):
+        self.S = int(l.shape[0])
+        self.c = c
+        self.c0 = c0
+        self.nonant_idx = nonant_idx
         self._payload = {
-            "A": A,
-            "l": np.asarray(batch.l, dtype=np.float64),
-            "u": np.asarray(batch.u, dtype=np.float64),
-            "lb": np.asarray(batch.lb, dtype=np.float64),
-            "ub": np.asarray(batch.ub, dtype=np.float64),
-            "integrality": np.asarray(batch.integer, dtype=np.uint8),
+            "A": A, "l": l, "u": u, "lb": lb, "ub": ub,
+            "integrality": integrality,
         }
         # n_workers=0 → inline (no subprocesses); None → one worker per
         # host core, capped at S. Even on a 1-core host the default is a
@@ -135,8 +159,10 @@ class OraclePool:
 
     def _ensure_pool(self):
         if self._pool is None:
-            self._pool = [_ProcWorker(self._payload)
-                          for _ in range(self.n_workers)]
+            import pickle
+            pb = pickle.dumps(self._payload,
+                              protocol=pickle.HIGHEST_PROTOCOL)
+            self._pool = [_ProcWorker(pb) for _ in range(self.n_workers)]
         return self._pool
 
     def _terminate_pool(self):
@@ -212,31 +238,47 @@ class OraclePool:
 
     # -- public API --
     def scenario_values(self, W=None, milp=False, time_limit=None,
-                        mip_gap=None, scenarios=None, kill_check=None):
+                        mip_gap=None, scenarios=None, kill_check=None,
+                        return_x=False):
         """Per-scenario certified lower values of
         min (c_s + W_s on nonant slots)·x over the LP (milp=False) or
         integer-feasible (milp=True) set, c0 included.
 
         Returns (vals (S,), ok (S,), optimal (S,)) — non-selected /
         failed scenarios get -inf and ok=False — or None if kill_check
-        tripped mid-refresh."""
+        tripped mid-refresh. With ``return_x`` a fourth element holds
+        the per-scenario primal feasible point (obj_with_c0, x) or None
+        — for MILPs that is the INCUMBENT (upper bound), while vals
+        stay the certified dual bounds."""
+        if W is not None and self.nonant_idx is None:
+            # a None index would silently act as np.newaxis below,
+            # smearing W over every objective entry
+            raise ValueError("this pool has no nonant index map "
+                             "(from_arrays without nonant_idx); W terms "
+                             "are not supported")
         sel = range(self.S) if scenarios is None else scenarios
         tasks = []
         for s in sel:
             q = self.c[s].copy()
             if W is not None:
                 q[self.nonant_idx] += np.asarray(W[s], dtype=np.float64)
-            tasks.append((s, q, bool(milp), time_limit, mip_gap))
+            tasks.append((s, q, bool(milp), time_limit, mip_gap,
+                          bool(return_x)))
         results = self._run(tasks, kill_check)
         if results is None:
             return None
         vals = np.full(self.S, -np.inf)
         ok = np.zeros(self.S, bool)
         opt = np.zeros(self.S, bool)
-        for s, v, o, is_opt in results:
+        xs = [None] * self.S
+        for s, v, o, is_opt, primal in results:
             vals[s] = v + (self.c0[s] if np.isfinite(v) else 0.0)
             ok[s] = o
             opt[s] = is_opt
+            if primal is not None:
+                xs[s] = (primal[0] + self.c0[s], primal[1])
+        if return_x:
+            return vals, ok, opt, xs
         return vals, ok, opt
 
     def lagrangian_bound(self, prob, W=None, milp=False, time_limit=None,
@@ -262,6 +304,169 @@ class OraclePool:
             self.close()
         except Exception:
             pass
+
+
+def make_w_projector(batch):
+    """Host-f64 projector onto the dual-feasible manifold
+    sum_s p_s W_s = 0 per (node, slot): W -> W minus its p-weighted
+    node mean, stage by stage. The per-stage (membership, node-mass)
+    pairs are precomputed — they are static per batch and the projector
+    runs on every bound refresh. Single implementation: the Lagrangian
+    spoke and solve_lp_ef must project IDENTICALLY or their bound
+    certificates diverge."""
+    prob = np.asarray(batch.prob, dtype=np.float64)
+    stages = []
+    for t, sl in enumerate(batch.stage_slot_slices):
+        B = np.asarray(batch.tree.membership(t + 1), dtype=np.float64)
+        stages.append((sl, B, B.T @ prob))
+
+    def project(W):
+        W = np.asarray(W, dtype=np.float64).reshape(len(prob), -1).copy()
+        for sl, B, pnode in stages:
+            num = B.T @ (prob[:, None] * W[:, sl])
+            W[:, sl] -= B @ (num / pnode[:, None])
+        return W
+
+    return project
+
+
+def build_ef_parts(batch):
+    """Sparse EQUALITY-ROW extensive-form pieces for host solvers.
+
+    Variables [x_0 .. x_{S-1}, z-blocks per non-leaf tree node];
+    per-scenario rows l <= A x_s <= u; linking rows
+    x_s[nonant] - z_{node(s,t)} = 0. Shared by the LP-dual extractor
+    (solve_lp_ef) and the host EF-MIP bounder — built sparse because
+    the EF of a 1000-scenario batch is far too big dense. (The DEVICE
+    EF engine (core/ef.py) substitutes shared columns instead; the
+    equality-row form exists exactly because its linking-row duals are
+    the Lagrangian warm start.)
+
+    Returns dict with A_ineq ((S*m, nv) csr), l_all/u_all (S*m,),
+    A_eq ((n_link, nv) csr), cv/lbv/ubv (nv,), integrality (nv,),
+    c0 (scalar), nv, n_link."""
+    from scipy import sparse
+
+    S, n, m, K = batch.S, batch.n, batch.m, batch.K
+    A = np.asarray(batch.A, dtype=np.float64)
+    lb = np.asarray(batch.lb, dtype=np.float64)
+    ub = np.asarray(batch.ub, dtype=np.float64)
+    c = np.asarray(batch.c, dtype=np.float64)
+    prob = np.asarray(batch.prob, dtype=np.float64)
+    idx = np.asarray(batch.nonant_idx)
+    integ = np.asarray(batch.integer, dtype=np.uint8)
+    if np.abs(np.asarray(batch.P_diag)).max() > 0:
+        raise ValueError("host oracle supports linear objectives only")
+
+    # z-block layout: per non-leaf stage, per node, that stage's slots
+    tree = batch.tree
+    slot_counts = [sl.stop - sl.start for sl in batch.stage_slot_slices]
+    z_off, off = [], S * n
+    for t, N in enumerate(tree.nodes_per_stage):
+        z_off.append(off)
+        off += N * slot_counts[t]
+    nv = off
+
+    blocks = []
+    for s in range(S):
+        A_s = A if A.ndim == 2 else A[s]
+        blocks.append(sparse.hstack(
+            [sparse.csr_matrix((m, s * n)), sparse.csr_matrix(A_s),
+             sparse.csr_matrix((m, nv - (s + 1) * n))]))
+    A_ineq = sparse.vstack(blocks).tocsr()
+    rows, cols, vals = [], [], []
+    r = 0
+    for s in range(S):
+        for t, sl in enumerate(batch.stage_slot_slices):
+            node = int(tree.node_path[s, t])
+            zbase = z_off[t] + node * slot_counts[t]
+            for k_local, j in enumerate(idx[sl.start:sl.stop]):
+                rows += [r, r]
+                cols += [s * n + int(j), zbase + k_local]
+                vals += [1.0, -1.0]
+                r += 1
+    A_eq = sparse.csr_matrix((vals, (rows, cols)), shape=(r, nv))
+    cv = np.zeros(nv)
+    lbv = np.full(nv, -np.inf)
+    ubv = np.full(nv, np.inf)
+    integrality = np.zeros(nv, dtype=np.uint8)
+    for s in range(S):
+        cv[s * n:(s + 1) * n] = prob[s] * c[s]
+        lbv[s * n:(s + 1) * n] = lb[s]
+        ubv[s * n:(s + 1) * n] = ub[s]
+        integrality[s * n:(s + 1) * n] = integ
+    return {
+        "A_ineq": A_ineq, "l_all": np.asarray(batch.l).reshape(-1),
+        "u_all": np.asarray(batch.u).reshape(-1), "A_eq": A_eq,
+        "cv": cv, "lbv": lbv, "ubv": ubv, "integrality": integrality,
+        "c0": float(np.dot(prob, np.asarray(batch.c0, np.float64))),
+        "nv": nv, "n_link": r,
+    }
+
+
+def solve_lp_ef(batch, time_limit=None):
+    """Solve the LP relaxation of the equality-row extensive form on
+    host and return ``(lp_obj, W_star)`` — the LP-EF optimum and the
+    nonant linking-row duals mapped to PH convention.
+
+    This is the decomposition-theory shortcut the tensor representation
+    makes nearly free: the Lagrangian dual of the LP relaxation is
+    MAXIMIZED at the LP-EF's linking-constraint duals, so
+    ``W_star = -mu / p`` (projected onto sum_s p_s W_s = 0 per node)
+    warm-starts any Lagrangian bounder at the LP ceiling instantly —
+    no W iteration needed — and the MIP oracle evaluated AT ``W_star``
+    starts within a whisker of the full Lagrangian dual. The reference
+    reaches comparable W only after ~100 PH iterations of Gurobi solves
+    (ref. examples/uc/quartz/10scen_nofw.baseline.out trajectory).
+
+    Returns (None, None) when the LP fails (caller falls back to
+    iterative bounds). Linear objectives, uniform-probability manifolds
+    only (the standard oracle eligibility)."""
+    from scipy import sparse
+    from scipy.optimize import linprog
+
+    S, K = batch.S, batch.K
+    prob = np.asarray(batch.prob, dtype=np.float64)
+    p = build_ef_parts(batch)
+    fin_u = np.isfinite(p["u_all"])
+    fin_l = np.isfinite(p["l_all"])
+    A_ub = sparse.vstack([p["A_ineq"][fin_u], -p["A_ineq"][fin_l]])
+    b_ub = np.concatenate([p["u_all"][fin_u], -p["l_all"][fin_l]])
+    opts = {}
+    if time_limit is not None:
+        opts["time_limit"] = float(time_limit)
+    res = linprog(p["cv"], A_ub=A_ub, b_ub=b_ub, A_eq=p["A_eq"],
+                  b_eq=np.zeros(p["n_link"]),
+                  bounds=list(zip(p["lbv"], p["ubv"])), method="highs",
+                  options=opts)
+    if res.status != 0 or res.eqlin is None:
+        return None, None
+    lp_obj = float(res.fun + p["c0"])
+    mu = np.asarray(res.eqlin.marginals).reshape(S, K)
+    # PH convention: subproblem objective carries +W_s·x with implied
+    # multipliers p_s W_s; the EF row  x_s - z = 0  carries -mu (HiGHS
+    # marginal sign), hence W = -mu/p. Re-project: simplex marginals of
+    # degenerate LPs can be off-manifold at 1e-9-level, and the bound
+    # certificate requires exact membership at f64.
+    return lp_obj, make_w_projector(batch)(-mu / prob[:, None])
+
+
+def ef_mip_pool(batch, n_workers=None):
+    """OraclePool holding the equality-row EF as a batch of ONE
+    problem — the host analog of the reference handing the monolithic
+    EF to a rented B&B solver (ref. mpisppy/opt/ef.py:61,
+    phbase.py:1307 SolverFactory). ``scenario_values(milp=True,
+    return_x=True)`` then yields (dual bound, incumbent, x_EF) with
+    kill-abortable subprocess execution."""
+    from scipy import sparse
+
+    p = build_ef_parts(batch)
+    A = sparse.vstack([p["A_ineq"], p["A_eq"]]).tocsr()
+    l = np.concatenate([p["l_all"], np.zeros(p["n_link"])])
+    u = np.concatenate([p["u_all"], np.zeros(p["n_link"])])
+    return OraclePool.from_arrays(
+        A, l, u, p["lbv"], p["ubv"], p["integrality"],
+        p["cv"], np.array([p["c0"]]), n_workers=n_workers)
 
 
 def exact_scenario_lp_values(batch, W=None, time_limit=None):

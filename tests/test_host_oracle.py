@@ -110,6 +110,99 @@ def test_quadratic_objective_rejected():
         OraclePool(b)
 
 
+def test_solve_lp_ef_duals_maximize_lp_lagrangian(ph_state):
+    """solve_lp_ef's W* attains the LP-Lagrangian maximum: L_LP(W*)
+    equals the LP-EF optimum exactly, and dominates L_LP(0) and the
+    PH-iterated W's LP bound."""
+    from mpisppy_tpu.utils.host_oracle import solve_lp_ef
+
+    b, W_ph, _ = ph_state
+    lp_obj, W_star = solve_lp_ef(b)
+    assert lp_obj is not None and W_star is not None
+    pool = OraclePool(b, n_workers=0)
+    at_star = pool.lagrangian_bound(b.prob, W_star)
+    assert at_star == pytest.approx(lp_obj, rel=1e-8)
+    assert at_star >= pool.lagrangian_bound(b.prob) - 1e-8 * abs(lp_obj)
+    assert at_star >= pool.lagrangian_bound(b.prob, W_ph) \
+        - 1e-8 * abs(lp_obj)
+
+
+def test_solve_lp_ef_multistage_matches_ef_engine():
+    """3-stage hydro: the host equality-row LP-EF optimum agrees with
+    the device shared-column EF engine, and the per-node-projected W*
+    reproduces it as a Lagrangian value."""
+    from mpisppy_tpu.core.ef import ExtensiveForm
+    from mpisppy_tpu.models import hydro
+    from mpisppy_tpu.utils.host_oracle import solve_lp_ef
+
+    b = build_batch(hydro.scenario_creator, hydro.make_tree((3, 3)))
+    lp_obj, W_star = solve_lp_ef(b)
+    ef_obj, _ = ExtensiveForm(
+        build_batch(hydro.scenario_creator,
+                    hydro.make_tree((3, 3)))).solve_extensive_form()
+    # device EF solves to ADMM tolerance (~1e-5 rel); host LP is exact
+    assert lp_obj == pytest.approx(ef_obj, rel=1e-4)
+    pool = OraclePool(b, n_workers=0)
+    assert pool.lagrangian_bound(b.prob, W_star) == \
+        pytest.approx(lp_obj, rel=1e-8)
+
+
+def test_ef_mip_pool_matches_device_ef(ph_state):
+    """The host EF-MIP pool's dual bound and incumbent bracket the
+    device EF engine's integer objective."""
+    from mpisppy_tpu.utils.host_oracle import ef_mip_pool
+
+    b, _, ef_obj = ph_state
+    pool = ef_mip_pool(b, n_workers=0)
+    vals, ok, opt, xs = pool.scenario_values(
+        milp=True, time_limit=60.0, mip_gap=1e-6, return_x=True)
+    assert ok[0] and xs[0] is not None
+    inc, x_ef = xs[0]
+    assert vals[0] <= ef_obj + 1e-6 * abs(ef_obj)
+    assert inc >= ef_obj - 1e-6 * abs(ef_obj)
+    assert inc == pytest.approx(ef_obj, rel=1e-4)
+
+
+def test_efmip_spoke_wheel_closes_gap():
+    """Wheel with the EF-MIP incumbent spoke + warm-started MIP-oracle
+    Lagrangian spoke: gap closes to ~the oracle mip_gap on integer UC."""
+    from mpisppy_tpu.core.ph import PH
+    from mpisppy_tpu.cylinders.hub import PHHub
+    from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
+    from mpisppy_tpu.cylinders.ef_bounder import EFMipInnerBound
+    from mpisppy_tpu.utils.sputils import spin_the_wheel
+
+    # generous iteration ceiling: the hub terminates on rel_gap once
+    # both host-oracle spokes publish; a tight limit would race the EF
+    # subprocess's startup under parallel-test load
+    opts = {"defaultPHrho": 50.0, "PHIterLimit": 500, "convthresh": -1.0,
+            "subproblem_max_iter": 1500, "subproblem_eps": 1e-7}
+    mk = _uc_batch
+    hub_dict = {"hub_class": PHHub,
+                "hub_kwargs": {"options": {"rel_gap": 5e-4}},
+                "opt_class": PH,
+                "opt_kwargs": {"batch": mk(), "options": opts}}
+    spoke_dicts = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": {"batch": mk(), "options": {
+             **opts, "lagrangian_exact_oracle": True,
+             "lagrangian_mip_oracle": True,
+             "lagrangian_mip_time_limit": 20.0,
+             "lagrangian_mip_gap": 1e-5,
+             "lagrangian_oracle_workers": 0}}},
+        # default 1-worker subprocess: inline (0) would make the single
+        # EF B&B un-abortable on the wheel's kill signal
+        {"spoke_class": EFMipInnerBound, "opt_class": PHBase,
+         "opt_kwargs": {"batch": mk(), "options": {
+             **opts, "efmip_time_limit": 60.0, "efmip_gap": 1e-5}}},
+    ]
+    wheel = spin_the_wheel(hub_dict, spoke_dicts)
+    _, rel_gap = wheel.gap()
+    assert rel_gap < 1e-3
+    xhat = wheel.best_xhat()
+    assert xhat is not None and xhat.shape[-1] == mk().K
+
+
 def test_spoke_mip_oracle_publishes_tighter_bound(ph_state):
     """LagrangianOuterBound with the MIP oracle: wired to a hand-driven
     hub window, a fresh W triggers an LP publish then a MIP refresh that
