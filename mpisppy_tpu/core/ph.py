@@ -35,9 +35,9 @@ import numpy as np
 
 from .. import global_toc
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_solve_mixed,
-                             qp_solve_segmented, qp_cold_state,
-                             qp_dual_objective)
+from ..ops.qp_solver import (QPData, QPState, qp_setup, qp_solve,
+                             qp_solve_mixed, qp_solve_segmented,
+                             qp_cold_state, qp_dual_objective)
 from .spbase import SPBase, compute_xbar
 
 
@@ -94,6 +94,74 @@ def _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w, memberships, idx,
         dual_obj
 
 
+@partial(jax.jit, static_argnames=("w_on",))
+def _ph_chunk_objs(x, yA, yB, d, q, c, c0, P0, idx, W, *, w_on):
+    """Per-chunk tail of the PH step under scenario microbatching:
+    everything that needs only THIS chunk's solve products (objectives +
+    certified dual bound). The cross-scenario reductions live in
+    _ph_combine."""
+    xn = x[:, idx]
+    base_obj = jnp.sum(c * x, axis=1) + c0 \
+        + 0.5 * jnp.sum(P0 * x * x, axis=1)
+    solved_obj = base_obj + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
+    dual_obj = qp_dual_objective(d, q, c0, yA, yB, x_witness=x)
+    return xn, base_obj, solved_obj, dual_obj
+
+
+@partial(jax.jit, static_argnames=("slot_slices",))
+def _ph_combine(xn, prob, xbar_w, memberships, W, rho, wmask, *,
+                slot_slices):
+    """Cross-scenario tail of the chunked PH step: Compute_Xbar +
+    Update_W + convergence over the FULL reassembled nonant block (the
+    membership reductions need every scenario; chunk solves don't)."""
+    K = xn.shape[1]
+    xbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn)
+    xsqbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn * xn)
+    W_new = W + rho * (xn - xbar_new)
+    if wmask is not None:
+        W_new = jnp.where(wmask, W_new, 0.0)
+    conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
+    return xbar_new, xsqbar_new, W_new, conv
+
+
+def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
+                 sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
+                 tail_iter, stall_rel, segment, polish_hot, polish_chunk):
+    """The ONE precision-policy + solver dispatch, shared by the fused
+    step and the chunked loop (a second copy would silently drift).
+
+    The PH hot loop consumes only primal iterates (bounds come from
+    prox-off solves), and on degenerate LPs the ADMM residuals plateau
+    far above tight tolerances — a tight test would burn the whole
+    iteration budget every PH iteration. Model configs that hit the
+    plateau (UC) opt in via subproblem_eps_hot / subproblem_eps_dua_hot
+    / subproblem_stall_rel: the LOOP criteria loosen for prox-on solves
+    and the active-set polish carries the point to machine accuracy
+    (measured: polish reaches ~1e-14 relative from a 1e-4-stalled loop
+    point on UC). Defaults keep the strict contract everywhere. The
+    polish serves DUAL accuracy (certified bounds) and final primal
+    refinement, so prox-on solves can skip it (subproblem_polish_hot)."""
+    e_pri = sub_eps_hot if (prox_on and sub_eps_hot is not None) else sub_eps
+    e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
+        else sub_eps
+    do_polish = polish_hot or not prox_on
+    if precision == "mixed":
+        # f32 bulk + f64 tail (see qp_solve_mixed): data/state stay f64
+        return qp_solve_mixed(factors, d, q, qp_state,
+                              max_iter=sub_max_iter, tail_iter=tail_iter,
+                              eps_abs=e_pri, eps_rel=e_pri,
+                              polish_chunk=polish_chunk,
+                              eps_abs_dua=e_dua, eps_rel_dua=e_dua,
+                              stall_rel=stall_rel, segment=segment,
+                              polish=do_polish)
+    return qp_solve_segmented(factors, d, q, qp_state,
+                              max_iter=sub_max_iter, segment=segment,
+                              eps_abs=e_pri, eps_rel=e_pri,
+                              polish_chunk=polish_chunk,
+                              eps_abs_dua=e_dua, eps_rel_dua=e_dua,
+                              stall_rel=stall_rel, polish=do_polish)
+
+
 def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              idx, W, xbar, rho, fixed_mask, fixed_vals, wscale=None, *,
              w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
@@ -117,42 +185,12 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
     (gigabytes at UC scale)."""
     q, d = _ph_assemble(data, c, W, xbar, rho, idx, fixed_mask, fixed_vals,
                         wscale, w_on=w_on, prox_on=prox_on)
-    # The PH hot loop consumes only primal iterates (bounds come from
-    # prox-off solves), and on degenerate LPs the ADMM residuals plateau
-    # far above tight tolerances — a tight test would burn the whole
-    # iteration budget every PH iteration. Model configs that hit the
-    # plateau (UC) opt in via subproblem_eps_hot / subproblem_eps_dua_hot
-    # / subproblem_stall_rel: the LOOP criteria loosen for prox-on solves
-    # and the active-set polish carries the point to machine accuracy
-    # (measured: polish reaches ~1e-14 relative from a 1e-4-stalled loop
-    # point on UC). Defaults keep the strict contract everywhere.
-    e_pri = sub_eps_hot if (prox_on and sub_eps_hot is not None) else sub_eps
-    e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
-        else sub_eps
-    # The polish serves DUAL accuracy (certified bounds) and final primal
-    # refinement; the PH hot loop consumes only the primal iterate at the
-    # loop's own tolerance, so prox-on solves can skip the batched
-    # (S, n, n) penalty factorizations entirely (subproblem_polish_hot)
-    do_polish = polish_hot or not prox_on
-    if precision == "mixed":
-        # f32 bulk + f64 tail (see qp_solve_mixed): data/state stay f64
-        qp_state, x, yA, yB = qp_solve_mixed(factors, d, q, qp_state,
-                                             max_iter=sub_max_iter,
-                                             tail_iter=tail_iter,
-                                             eps_abs=e_pri,
-                                             eps_rel=e_pri,
-                                             polish_chunk=polish_chunk,
-                                             eps_abs_dua=e_dua,
-                                             eps_rel_dua=e_dua,
-                                             stall_rel=stall_rel,
-                                             segment=segment,
-                                             polish=do_polish)
-    else:
-        qp_state, x, yA, yB = qp_solve_segmented(
-            factors, d, q, qp_state, max_iter=sub_max_iter,
-            segment=segment, eps_abs=e_pri, eps_rel=e_pri,
-            polish_chunk=polish_chunk, eps_abs_dua=e_dua,
-            eps_rel_dua=e_dua, stall_rel=stall_rel, polish=do_polish)
+    qp_state, x, yA, yB = _solver_call(
+        factors, d, q, qp_state, prox_on=prox_on, precision=precision,
+        sub_max_iter=sub_max_iter, sub_eps=sub_eps,
+        sub_eps_hot=sub_eps_hot, sub_eps_dua_hot=sub_eps_dua_hot,
+        tail_iter=tail_iter, stall_rel=stall_rel, segment=segment,
+        polish_hot=polish_hot, polish_chunk=polish_chunk)
     wmask = None if wscale is None else wscale > 0
     (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
      dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
@@ -160,6 +198,34 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
                             slot_slices=slot_slices)
     return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
         conv, base_obj, solved_obj, dual_obj
+
+
+class _ChunkStateView:
+    """Lazy concatenated view over per-chunk QPStates. The state
+    consumers (iter-0 feasibility checks, incumbent feasibility, bench
+    prints, warm-start transplants) read it occasionally, while the
+    chunked hot loop runs every PH iteration — eagerly concatenating
+    zA/zB (O(S·(m+n)) device copies) per solve call would tax the hot
+    loop for readers that may never come. Attribute access
+    concatenates on demand and caches on the instance."""
+
+    _FIELDS = ("x", "yA", "yB", "zA", "zB", "pri_res", "dua_res",
+               "pri_rel")
+
+    def __init__(self, states, trims, precomputed=None):
+        self._states = list(states)
+        self._trims = list(trims)
+        for k, v in (precomputed or {}).items():
+            setattr(self, k, v)
+
+    def __getattr__(self, name):
+        if name in _ChunkStateView._FIELDS:
+            val = jnp.concatenate(
+                [getattr(s, name)[:r]
+                 for s, r in zip(self._states, self._trims)])
+            setattr(self, name, val)
+            return val
+        raise AttributeError(name)
 
 
 class PHBase(SPBase):
@@ -293,6 +359,8 @@ class PHBase(SPBase):
         for cache in (self._factors, self._qp_states):
             cache.pop(True, None)
             cache.pop(("fixed", True), None)
+            cache.pop(("chunks", True), None)
+            cache.pop(("chunks", ("fixed", True)), None)
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
@@ -301,7 +369,9 @@ class PHBase(SPBase):
         if key not in self._qp_states:
             factors, d = self._get_factors(prox_on, fixed)
             st = qp_cold_state(factors, d)
-            other = next((v for k, v in self._qp_states.items() if k != key),
+            other = next((v for k, v in self._qp_states.items()
+                          if k != key
+                          and isinstance(v, (QPState, _ChunkStateView))),
                          None)
             if other is not None and other.x.shape == st.x.shape \
                     and other.zA.shape == st.zA.shape:
@@ -312,15 +382,194 @@ class PHBase(SPBase):
             self._qp_states[key] = st
         return self._qp_states[key]
 
+    # ------------- scenario microbatching -------------
+    def _chunk_index(self, chunk):
+        """Per-chunk scenario index arrays, every one exactly ``chunk``
+        long: a ragged final chunk would force a second XLA compile of
+        every solve program for the odd shape (~minutes per program on
+        tunneled TPU runtimes), so the tail is padded by REPEATING its
+        last scenario — the duplicate rows solve redundantly and their
+        outputs are trimmed before the global reduce."""
+        S = self.batch.S
+        if not hasattr(self, "_chunk_idx_cache"):
+            self._chunk_idx_cache = {}
+        if chunk not in self._chunk_idx_cache:
+            out = []
+            for i in range(0, S, chunk):
+                idx = np.arange(i, min(i + chunk, S))
+                real = idx.size
+                if real < chunk:
+                    idx = np.concatenate(
+                        [idx, np.full(chunk - real, idx[-1])])
+                out.append((jnp.asarray(idx), real))
+            self._chunk_idx_cache[chunk] = out
+        return self._chunk_idx_cache[chunk]
+
+    def _ensure_chunk_states(self, key, factors, data, slices):
+        """Per-chunk QPStates (each owns its L / rho_scale trajectory —
+        cross-chunk sharing would let one chunk's rho adaptation corrupt
+        another's warm start). Authoritative store for chunked mode;
+        self._qp_states[key] holds a concatenated read-only view.
+
+        New modes transplant iterates from any existing mode's
+        concatenated view, exactly like _ensure_state: a cold prox-off
+        start would cost thousands of ADMM iterations of certified-
+        bound tightness every Lagrangian pass."""
+        ck = ("chunks", key)
+        if ck not in self._qp_states:
+            other = next((v for k, v in self._qp_states.items()
+                          if k != ck
+                          and isinstance(v, (QPState, _ChunkStateView))),
+                         None)
+            states = []
+            for idx, _ in slices:
+                st = qp_cold_state(factors, data._replace(
+                    l=data.l[idx], u=data.u[idx],
+                    lb=data.lb[idx], ub=data.ub[idx]))
+                if other is not None and \
+                        other.x.shape[0] == self.batch.S and \
+                        other.zA.shape[1] == st.zA.shape[1]:
+                    st = st._replace(x=other.x[idx], yA=other.yA[idx],
+                                     yB=other.yB[idx], zA=other.zA[idx],
+                                     zB=other.zB[idx])
+                states.append(st)
+            self._qp_states[ck] = states
+        return self._qp_states[ck]
+
+    def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed):
+        """Host-looped scenario microbatching: S scenarios solved in
+        ceil(S/chunk) shared-factor kernel calls, then one global
+        membership reduce. This is the single-chip path to the
+        1000-scenario north star (ref. paperruns/larger_uc/
+        1000scenarios_wind): solver-grade (mixed-precision) solves are
+        stable at <=128 scenarios per device call on current TPU
+        runtimes, while the cross-scenario reductions are cheap at any
+        S. Requires shared structure (one A / P across scenarios — the
+        representation that makes single-factor chunking exact)."""
+        key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+        factors, data = self._get_factors(prox_on, fixed)
+        if factors.A_s.ndim != 2:
+            raise ValueError(
+                "subproblem_chunk requires a shared-structure batch "
+                "(every scenario must carry the same A and P; "
+                "per-scenario matrices need per-scenario factors and "
+                "gain nothing from chunking)")
+        slices = self._chunk_index(chunk)
+        states = self._ensure_chunk_states(key, factors, data, slices)
+        polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
+        parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
+                                 "dual")}
+        for ci, (idx_c, real) in enumerate(slices):
+            d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
+                                lb=data.lb[idx_c], ub=data.ub[idx_c])
+            ws = None if self._w_scale is None else self._w_scale[idx_c]
+            q_c, d_c = _ph_assemble(d_c, self.c[idx_c], self.W[idx_c],
+                                    self.xbar[idx_c], self.rho[idx_c],
+                                    self.nonant_idx,
+                                    self._fixed_mask[idx_c],
+                                    self._fixed_vals[idx_c], ws,
+                                    w_on=bool(w_on), prox_on=bool(prox_on))
+            st, x, yA, yB = _solver_call(
+                factors, d_c, q_c, states[ci], prox_on=bool(prox_on),
+                precision=self.sub_precision,
+                sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
+                sub_eps_hot=self.sub_eps_hot,
+                sub_eps_dua_hot=self.sub_eps_dua_hot,
+                tail_iter=self.sub_tail_iter,
+                stall_rel=self.sub_stall_rel, segment=self.sub_segment,
+                polish_hot=self.sub_polish_hot, polish_chunk=polish_chunk)
+            states[ci] = st
+            xn, base, solved, dual = _ph_chunk_objs(
+                x, yA, yB, d_c, q_c, self.c[idx_c], self.c0[idx_c],
+                self.P_diag[idx_c], self.nonant_idx, self.W[idx_c],
+                w_on=bool(w_on))
+            for k, v in (("x", x[:real]), ("yA", yA[:real]),
+                         ("yB", yB[:real]), ("xn", xn[:real]),
+                         ("base", base[:real]), ("solved", solved[:real]),
+                         ("dual", dual[:real])):
+                parts[k].append(v)
+        cat = {k: jnp.concatenate(v) for k, v in parts.items()}
+        # lazily concatenated read-only view for the state consumers
+        # (assert_feasible_iter0, incumbent feasibility, bench prints);
+        # per-chunk states stay authoritative for warm starts
+        self._qp_states[key] = _ChunkStateView(
+            states, [real for _, real in slices],
+            precomputed={"x": cat["x"], "yA": cat["yA"],
+                         "yB": cat["yB"]})
+        self.x, self.yA, self.yB = cat["x"], cat["yA"], cat["yB"]
+        if update:
+            wmask = None if self._w_scale is None else self._w_scale > 0
+            xbar_new, xsqbar_new, W_new, conv = _ph_combine(
+                cat["xn"], self.prob, self.xbar_weights,
+                tuple(self.memberships), self.W, self.rho, wmask,
+                slot_slices=tuple(self.slot_slices))
+            self.xbar, self.xsqbar = xbar_new, xsqbar_new
+            self.W_new = W_new
+            self.conv = float(conv)
+        self._last_base_obj = cat["base"]
+        self._last_solved_obj = cat["solved"]
+        self._last_dual_obj = cat["dual"]
+        self._ext("post_solve")
+        return cat["solved"]
+
+    def _dive_in_chunks(self, factors, d, q, c0, st, imask, **kw):
+        """core.mip.dive_integers with scenario microbatching. Dives
+        have NO cross-scenario coupling (each scenario pins its own
+        columns), so chunking is exact; without it a 1024-scenario dive
+        would launch full-batch f64-involving device calls — the
+        unstable regime subproblem_chunk exists to avoid."""
+        from .mip import dive_integers
+
+        chunk = int(self.options.get("subproblem_chunk", 0))
+        S = self.batch.S
+        if not (chunk and chunk < S):
+            return dive_integers(factors, d, q, c0, st, imask, **kw)
+        if factors.A_s.ndim != 2:
+            raise ValueError("subproblem_chunk requires a shared-"
+                             "structure batch (see _solve_loop_chunked)")
+        n = d.lb.shape[-1]
+        imask_b = jnp.broadcast_to(jnp.asarray(imask, bool), (S, n))
+        q_b = jnp.broadcast_to(jnp.asarray(q), (S, n))
+        c0_b = jnp.broadcast_to(jnp.asarray(c0), (S,))
+        xs, objs, feas = [], [], []
+        for idx_c, real in self._chunk_index(chunk):
+            d_c = d._replace(l=d.l[idx_c], u=d.u[idx_c],
+                             lb=d.lb[idx_c], ub=d.ub[idx_c])
+            st_c = st._replace(
+                x=st.x[idx_c], yA=st.yA[idx_c], yB=st.yB[idx_c],
+                zA=st.zA[idx_c], zB=st.zB[idx_c],
+                pri_res=st.pri_res[idx_c], dua_res=st.dua_res[idx_c],
+                pri_rel=st.pri_rel[idx_c])
+            x, o, f, _ = dive_integers(factors, d_c, q_b[idx_c],
+                                       c0_b[idx_c], st_c,
+                                       imask_b[idx_c], **kw)
+            xs.append(x[:real])
+            objs.append(o[:real])
+            feas.append(f[:real])
+        return (jnp.concatenate(xs), jnp.concatenate(objs),
+                jnp.concatenate(feas), st)
+
     # ------------- the fused PH step -------------
     def solve_loop(self, w_on=True, prox_on=True, update=True, fixed=False):
         """One batched solve pass in the given mode; mirrors solve_loop
         (ref. phbase.py:999) + Compute_Xbar + Update_W fused. Returns the
         per-scenario *solved* objective (including the W term when w_on,
         which is what Ebound of a Lagrangian pass needs). ``fixed=True``
-        selects the eq-boosted factorization for fully-pinned solves."""
+        selects the eq-boosted factorization for fully-pinned solves.
+        With ``subproblem_chunk`` set below S, the solve microbatches
+        over scenario chunks (see _solve_loop_chunked)."""
         import time as _time
         t0 = _time.perf_counter()
+        chunk = int(self.options.get("subproblem_chunk", 0))
+        if chunk and chunk < self.batch.S:
+            out = self._solve_loop_chunked(chunk, w_on, prox_on, update,
+                                           fixed)
+            if self._timing:
+                jax.block_until_ready(self.x)
+                self._solve_times.setdefault(
+                    (bool(w_on), bool(prox_on), bool(fixed)), []).append(
+                    _time.perf_counter() - t0)
+            return out
         qp_state = self._ensure_state(prox_on, fixed)
         factors, data = self._get_factors(prox_on, fixed)
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
@@ -489,7 +738,6 @@ class PHBase(SPBase):
             rec_ints = np.asarray(self.batch.integer) & ~nonant_cols
             if rec_ints.any() and self.options.get("xhat_dive_integers",
                                                    True):
-                from .mip import dive_integers
                 factors, d0 = self._get_factors(False, fixed=True)
                 idx = self.nonant_idx
                 lb = d0.lb.at[:, idx].set(
@@ -500,7 +748,7 @@ class PHBase(SPBase):
                               d0.ub[:, idx]))
                 d = d0._replace(lb=lb, ub=ub)
                 st = self._ensure_state(False, fixed=True)
-                x, obj, feasible, _ = dive_integers(
+                x, obj, feasible, _ = self._dive_in_chunks(
                     factors, d, self.c, self.c0, st, rec_ints,
                     max_iter=self.sub_max_iter, eps=self.sub_eps,
                     feas_tol=feas_tol,
@@ -538,7 +786,6 @@ class PHBase(SPBase):
         inner solves, candidates that track the hub's trajectory.
 
         Returns (cands (S, K), feasible (S,) bool)."""
-        from .mip import dive_integers
         n = self.batch.n
         idx_np = np.asarray(self.batch.nonant_idx)
         imask = np.zeros(n, bool)
@@ -554,7 +801,7 @@ class PHBase(SPBase):
         else:
             q = self.c
         st = self._ensure_state(prox_on)
-        x, _, feasible, _ = dive_integers(
+        x, _, feasible, _ = self._dive_in_chunks(
             factors, d, q, self.c0, st, jnp.asarray(imask),
             max_iter=int(max_iter or min(self.sub_max_iter, 1500)),
             eps=max(self.sub_eps, 1e-6), feas_tol=feas_tol,

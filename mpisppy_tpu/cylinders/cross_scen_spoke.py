@@ -23,10 +23,19 @@ from .spoke import Spoke, ConvergerSpokeType
 class CrossScenarioCutSpoke(Spoke):
     converger_spoke_types = (ConvergerSpokeType.NONANT_GETTER,)
     converger_spoke_char = "C"
+    # classification marker: the hub (and the multi-process proxy, which
+    # never holds the real class instance) route cut-window reads on it
+    is_cut_spoke = True
+
+    @staticmethod
+    def payload_length(S, K) -> int:
+        """Cut-window layout: S rows of [const, *K nonant coefs]. ONE
+        source of truth — the multi-process proxy sizes the hub-side
+        shared window from this too."""
+        return S * (1 + K)
 
     def local_window_length(self) -> int:
-        S, K = self.opt.batch.S, self.opt.batch.K
-        return S * (1 + K)
+        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
 
     def _select_candidate(self, X):
         """x̂ = the scenario row farthest (L2) from the prob-weighted mean

@@ -48,8 +48,13 @@ class EFMipInnerBound(InnerBoundSpoke):
                                                   180.0)),
                 mip_gap=float(self.options.get("efmip_gap", 1e-4)),
                 kill_check=self.killed, return_x=True)
-        except Exception:
-            res = None   # host solver hiccup: publish nothing, idle out
+        except Exception as e:
+            # never crash the wheel over a host solver hiccup — but say
+            # so: this may be the wheel's only inner-bound source
+            from .. import global_toc
+            global_toc(f"EFMipInnerBound: EF solve failed ({e!r}); "
+                       "publishing no inner bound")
+            res = None
         if res is not None and res[3][0] is not None:
             obj, x_ef = res[3][0]
             n = b.n

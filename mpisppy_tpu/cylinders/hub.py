@@ -120,11 +120,8 @@ class Hub(SPCommunicator):
                 self.gap_mark_times[mark] = time.perf_counter()
         abs_opt = self.options.get("abs_gap", None)
         rel_opt = self.options.get("rel_gap", None)
-        hit = (abs_opt is not None and abs_gap <= abs_opt) or \
+        return (abs_opt is not None and abs_gap <= abs_opt) or \
             (rel_opt is not None and rel_gap <= rel_opt)
-        if hit and not hasattr(self, "gap_reached_at"):
-            self.gap_reached_at = time.perf_counter()
-        return hit
 
     def screen_trace(self, it):
         # print a row only when a bound moved (ref. hub.py:108-121)
@@ -204,9 +201,10 @@ class CrossScenarioHub(PHHub):
 
     def setup_hub(self):
         super().setup_hub()
-        from .cross_scen_spoke import CrossScenarioCutSpoke
+        # attribute-based classification: multi-process wheels hand the
+        # hub SpokeProxy objects, never real spoke instances
         self.cut_spoke_indices = {i for i, sp in enumerate(self.spokes)
-                                  if isinstance(sp, CrossScenarioCutSpoke)}
+                                  if getattr(sp, "is_cut_spoke", False)}
 
     def receive_bounds(self):
         S, K = self.opt.batch.S, self.opt.batch.K
@@ -216,6 +214,10 @@ class CrossScenarioHub(PHHub):
             if wid == sp.my_window.KILL or wid <= self._spoke_last_ids[i]:
                 continue
             self._spoke_last_ids[i] = wid
+            if np.isnan(values).all():
+                # a process spoke's startup hello (all-NaN payload) —
+                # consumed for readiness, never installed as cuts
+                continue
             rows = values.reshape(S, 1 + K)
             self.opt.add_cuts(rows[:, 0], rows[:, 1:])
         super().receive_bounds()

@@ -401,7 +401,7 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
             improved = (pri <= 0.95 * best_pri) | (dua <= 0.95 * best_dua)
             best_pri = jnp.minimum(best_pri, pri)
             best_dua = jnp.minimum(best_dua, dua)
-        rho_changed = jnp.array(False)
+        rho_changed = jnp.zeros_like(conv_ok)   # per-scenario where possible
         if adaptive_rho:
             # OSQP-style infrequent adaptation: every 4th residual check;
             # adopt only when the ideal rho moved by > 5x. In shared mode
@@ -420,6 +420,9 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
                 upd = (change > 5.0) & adapt_now & not_conv
                 rho_scale = jnp.where(upd, new_scale, rho_scale)
                 need = upd
+                # one shared scalar: a refactorize resets every
+                # scenario's stall window (their stepsize DID change)
+                rho_changed = jnp.broadcast_to(need, conv_ok.shape)
             else:
                 new_scale = jnp.clip(rho_scale * ratio_s, 1e-6, 1e6)
                 change = jnp.maximum(new_scale / rho_scale,
@@ -427,9 +430,13 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
                 mask = (change > 5.0) & adapt_now & not_conv
                 rho_scale = jnp.where(mask, new_scale, rho_scale)
                 need = jnp.any(mask)
+                # per-scenario rho: only the scenarios whose rho moved
+                # restart their stall window — an unrelated scenario's
+                # refactorize must not postpone another's plateau exit
+                # (ADVICE r2)
+                rho_changed = mask
             L = jax.lax.cond(need, lambda: _factorize(factors, rho_scale),
                              lambda: L)
-            rho_changed = need
         if stall_rel:
             # a rho refactorize resets the window (the residual jump is
             # expected, not a plateau)
@@ -542,7 +549,12 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
     one host dispatch per ``segment`` iterations (microseconds against
     tens of milliseconds of device work) and buys bounded execution
     times, warm-started continuation, and a natural place for host-side
-    progress control. Returns the same (state, x, yA, yB) contract."""
+    progress control. Returns the same (state, x, yA, yB) contract.
+
+    NOTE: segments always run FULL (``segment`` is a static jit arg),
+    so the total can overshoot ``max_iter`` by up to one segment —
+    ``max_iter=100, segment=500`` runs up to 500 iterations. Callers
+    that need a hard ceiling pass ``segment <= max_iter``."""
     final_polish = kw.pop("polish", True)
     total = 0
     while total < max_iter:
@@ -589,13 +601,18 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     work, the f64 tail does the accuracy work. Everything (factors, data,
     state) arrives in f64; the f32 copies are cast inside the jit.
 
-    tail_iter bounds the f64 phase; rho adaptation stays on in both
-    phases (the tail refactorizes in f64 when the ratio moves >5x — a
-    few hundred ms, worth it when the f32 handoff mis-scaled rho). Both
-    phases run SEGMENTED (at most ``segment`` iterations per device
-    execution) for the same watchdog reason as qp_solve_segmented.
-    Returns the same (state, x, yA, yB) contract as qp_solve, with the
-    state in f64.
+    BUDGET SEMANTICS: ``max_iter`` bounds only the f32 bulk phase and
+    ``tail_iter`` the f64 tail — total work can reach max_iter +
+    tail_iter (plus one segment of overshoot each, see
+    qp_solve_segmented). PH's ``subproblem_max_iter`` therefore caps
+    the bulk, not the sum, when subproblem_precision='mixed'; the tail
+    is bounded separately by ``subproblem_tail_iter``. rho adaptation
+    stays on in both phases (the tail refactorizes in f64 when the
+    ratio moves >5x — worth it when the f32 handoff mis-scaled rho).
+    Both phases run SEGMENTED (at most ``segment`` iterations per
+    device execution) for the same watchdog reason as
+    qp_solve_segmented. Returns the same (state, x, yA, yB) contract as
+    qp_solve, with the state in f64.
     """
     lo = jnp.float32
     f_lo = _cast_floats(factors, lo)

@@ -124,8 +124,12 @@ def solve_scenario(state: dict, task):
         if want_x and res.x is not None else None
     if want_milp:
         # HiGHS's dual (best) bound is a valid lower bound at ANY stop
-        # reason; -inf / None means nothing was proven
+        # reason; -inf / None means nothing was proven. On a model with
+        # no integer columns scipy returns mip_dual_bound=None even at
+        # optimality — the LP optimum IS the dual bound there
         val = res.mip_dual_bound
+        if val is None and res.status == 0 and res.fun is not None:
+            val = res.fun
         ok = val is not None and np.isfinite(val)
         optimal = bool(res.status == 0)
         return s, (float(val) if ok else -np.inf), ok, optimal, primal

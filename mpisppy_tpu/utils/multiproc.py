@@ -12,12 +12,14 @@ hub and spoke code runs unchanged.
 Resource split: spoke processes default to the CPU backend
 (JAX_PLATFORMS=cpu) so the accelerator stays exclusively the hub's —
 bound evaluation rides host cores, the batched PH iteration rides the
-chip. On a multi-chip host, export per-process device assignments
-instead.
+chip. On a multi-chip host, per-spoke ``jax_platform`` /
+``jax_visible_devices`` options pin each cylinder to its own chip (see
+_spoke_worker) — the real deployment shape of the reference's
+process grid (one cylinder per rank group, ref. sputils.py:133-151).
 
-Two-stage of the reference's taxonomy is supported (bound spokes); the
-cross-scenario cut spoke needs the larger cut-window layout and stays
-in-process for now.
+The full spoke taxonomy runs as processes, including the
+cross-scenario cut spoke (its larger cut-window layout is sized by the
+hub-side proxy).
 """
 
 from __future__ import annotations
@@ -38,8 +40,10 @@ class SpokeProxy:
     classification surface + the shared window pair."""
 
     def __init__(self, spoke_cls, S, K, hub_window, my_window):
+        self._spoke_cls = spoke_cls
         self.converger_spoke_types = spoke_cls.converger_spoke_types
         self.converger_spoke_char = spoke_cls.converger_spoke_char
+        self.is_cut_spoke = bool(getattr(spoke_cls, "is_cut_spoke", False))
         self._S, self._K = S, K
         self.hub_window = hub_window
         self.my_window = my_window
@@ -54,13 +58,34 @@ class SpokeProxy:
         return self._S * self._K * (int(has_w) + int(has_x))
 
     def local_window_length(self) -> int:
+        if self.is_cut_spoke:
+            # the spoke class owns its payload layout — sizing it here
+            # too would let the two windows drift apart
+            return self._spoke_cls.payload_length(self._S, self._K)
         return 1          # bound spokes publish [bound]
 
 
 def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
     """Runs in the child process: build the engine from the config, wire
-    the shared windows, loop until the hub's kill signal."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    the shared windows, loop until the hub's kill signal.
+
+    Per-process device assignment (the real multi-chip deployment shape:
+    one cylinder per chip, ref. sputils.py:133-151 process-grid): the
+    spoke's options may carry ``jax_platform`` ("cpu" default — the
+    accelerator stays the hub's) and ``jax_visible_devices`` (a
+    TPU_VISIBLE_DEVICES / CUDA_VISIBLE_DEVICES value pinning this
+    cylinder to its chip). Both must land in the environment BEFORE jax
+    imports in this process."""
+    opts = spoke_cfg_dict.get("options") or {}
+    platform = str(opts.get("jax_platform", "cpu"))
+    os.environ["JAX_PLATFORMS"] = platform
+    vis = opts.get("jax_visible_devices")
+    if vis is not None:
+        env_key = {"tpu": "TPU_VISIBLE_DEVICES",
+                   "gpu": "CUDA_VISIBLE_DEVICES",
+                   "cuda": "CUDA_VISIBLE_DEVICES"}.get(platform)
+        if env_key:
+            os.environ[env_key] = str(vis)
     from .runtime import setup_jax_runtime
 
     setup_jax_runtime(f32)
@@ -103,10 +128,6 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
     the wheel. The spawn context is used so children re-initialize JAX
     cleanly (a forked JAX runtime is unsupported)."""
     cfg.validate()
-    for sp in cfg.spokes:
-        if sp.kind == "cross_scenario":
-            raise ValueError("cross_scenario spokes are in-process only "
-                             "for now")
 
     from .vanilla import hub_dict, spoke_classes
 

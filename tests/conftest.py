@@ -11,6 +11,15 @@ jax.config before any computation runs.
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # two tiers, mirroring the reference's per-push CI vs nightly sweep
+    # (ref. .github/workflows/pull_push_regression.yml vs weekly.yml):
+    # `pytest -m "not slow"` is the per-push tier (< 2 min), the full
+    # suite the nightly one (< 10 min)
+    config.addinivalue_line(
+        "markers", "slow: long-running tier (full-suite runs only)")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")

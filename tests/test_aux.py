@@ -84,3 +84,34 @@ def test_spoke_live_trace_file(tmp_path):
     assert os.path.exists(path)
     lines = open(path).read().strip().splitlines()
     assert lines[0] == "time,bound" and len(lines) >= 2
+
+
+def test_ef_nonants_csv_and_xhat_csv(tmp_path):
+    """Solution CSV exports (ref. mpisppy/utils/sputils.py:438
+    ef_nonants_csv; ref. extensions/xhatbase.py:147-189 xhat dumps)."""
+    import numpy as np
+    from mpisppy_tpu.core.ef import ExtensiveForm
+    from mpisppy_tpu.utils.sputils import (ef_nonants_csv, nonant_slot_names,
+                                           write_xhat_csv)
+
+    batch = _batch()
+    ef = ExtensiveForm(batch)
+    ef.solve_extensive_form()
+    path = tmp_path / "ef_nonants.csv"
+    ef_nonants_csv(ef, path)
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "scenario, varname, value"
+    assert len(lines) == 1 + batch.S * batch.K
+    # values round-trip and agree with the solved nonants
+    scen, vn, val = lines[1].split(", ")
+    assert scen == batch.tree.scen_names[0]
+    assert vn == nonant_slot_names(batch)[0]
+    xn0 = float(np.asarray(ef.x_batch)[0, np.asarray(batch.nonant_idx)[0]])
+    assert float(val) == xn0
+
+    xpath = tmp_path / "xhat.csv"
+    write_xhat_csv(np.asarray(ef.x_batch)[0, np.asarray(batch.nonant_idx)],
+                   xpath, batch)
+    lines = open(xpath).read().strip().splitlines()
+    assert lines[0] == "varname, value"
+    assert len(lines) == 1 + batch.K

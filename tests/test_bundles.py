@@ -36,6 +36,7 @@ def test_bundled_ef_matches_unbundled():
     assert obj1 == pytest.approx(obj0, abs=1.0)
 
 
+@pytest.mark.slow
 def test_bundled_ph_agrees_with_unbundled():
     batch = _batch(4)
     ph0 = PH(batch, _opts())

@@ -14,6 +14,7 @@ def _batch():
     return build_batch(ccopf.scenario_creator, ccopf.make_tree())
 
 
+@pytest.mark.slow
 def test_ccopf_four_stage_ef_and_ph_agree():
     """EF and converged PH must agree on the 4-stage quadratic model
     (the hydro-style parity check at acopf3 depth)."""

@@ -163,6 +163,7 @@ def test_ef_mip_pool_matches_device_ef(ph_state):
     assert inc == pytest.approx(ef_obj, rel=1e-4)
 
 
+@pytest.mark.slow
 def test_efmip_spoke_wheel_closes_gap():
     """Wheel with the EF-MIP incumbent spoke + warm-started MIP-oracle
     Lagrangian spoke: gap closes to ~the oracle mip_gap on integer UC."""

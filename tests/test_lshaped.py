@@ -56,6 +56,43 @@ def test_lshaped_cut_validity():
     assert np.all(cut_at_probe <= V_true + 1e-4 * np.maximum(1, np.abs(V_true)))
 
 
+def test_small_cut_buffer_matches_unlimited():
+    """Slack-aware eviction: a tiny rolling buffer reaches the same
+    bound as an effectively unlimited one — binding cuts survive
+    (VERDICT r2: oldest-first eviction discarded binding cuts). Run on
+    20-scenario netdes, the reference's cut-heavy showcase
+    (ref. examples/netdes/netdes_cylinders.py)."""
+    from mpisppy_tpu.models import netdes
+
+    def mk():
+        return build_batch(netdes.scenario_creator, netdes.make_tree(20))
+
+    big = LShapedMethod(mk(), {"max_iter": 30, "cuts_per_scenario": 64})
+    lb_big, ub_big, _ = big.lshaped_algorithm()
+    small = LShapedMethod(mk(), {"max_iter": 30, "cuts_per_scenario": 4})
+    lb_small, ub_small, _ = small.lshaped_algorithm()
+    assert lb_small == pytest.approx(lb_big, rel=1e-5)
+    assert ub_small == pytest.approx(ub_big, rel=1e-4)
+
+
+def test_scenarios_in_master():
+    """The in-master-scenarios variant (ref. lshaped.py:225-309):
+    carrying one scenario's full second stage in the master converges
+    to the same EF optimum, and with ALL scenarios in the master the
+    first master solve IS the EF."""
+    ls = LShapedMethod(_batch(), {"max_iter": 40,
+                                  "master_scenarios": [0]})
+    lb, ub, xf = ls.lshaped_algorithm()
+    assert lb == pytest.approx(EF_OBJ, rel=2e-3)
+    assert ub == pytest.approx(EF_OBJ, rel=2e-3)
+    assert xf == pytest.approx([170.0, 80.0, 250.0], abs=3.0)
+
+    ls_all = LShapedMethod(_batch(), {"max_iter": 3,
+                                      "master_scenarios": [0, 1, 2]})
+    lb_all, ub_all, _ = ls_all.lshaped_algorithm()
+    assert lb_all == pytest.approx(EF_OBJ, rel=1e-5)
+
+
 def test_lshaped_hub_with_xhat_spoke():
     batch = _batch()
     opts = {"max_iter": 40, "defaultPHrho": 10.0}

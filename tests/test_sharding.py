@@ -24,6 +24,7 @@ def test_mesh_has_8_devices():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow
 def test_sharded_ph_matches_single_device():
     batch = build_batch(farmer.scenario_creator, farmer.make_tree(8))
     ph0 = PH(batch, _opts(3))
