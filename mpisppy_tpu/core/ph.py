@@ -75,21 +75,15 @@ def _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w, memberships, idx,
     """Stage 3: Compute_Xbar + Update_W + convergence + objectives +
     certified dual bound (cheap reductions). ``wmask`` (None, or (S, K)
     bool) zeroes the W of zero-probability entries — the reference's
-    w_coeff mask (ref. phbase.py:245-251)."""
-    xn = x[:, idx]
-    K = xn.shape[1]
-    xbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn)
-    xsqbar_new = compute_xbar(memberships, slot_slices, xbar_w, xn * xn)
-    W_new = W + rho * (xn - xbar_new)
-    if wmask is not None:
-        W_new = jnp.where(wmask, W_new, 0.0)
-    conv = jnp.dot(prob, jnp.sum(jnp.abs(xn - xbar_new), axis=1)) / K
-    base_obj = jnp.sum(c * x, axis=1) + c0 \
-        + 0.5 * jnp.sum(P0 * x * x, axis=1)
-    solved_obj = base_obj + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
-    # certified lower bound on each subproblem's optimum (valid for
-    # prox-off solves; see qp_dual_objective)
-    dual_obj = qp_dual_objective(d, q, c0, yA, yB, x_witness=x)
+    w_coeff mask (ref. phbase.py:245-251). Pure COMPOSITION of
+    _ph_chunk_objs + _ph_combine so the fused and chunked paths share
+    one implementation of every formula (a second copy would silently
+    drift)."""
+    xn, base_obj, solved_obj, dual_obj = _ph_chunk_objs(
+        x, yA, yB, d, q, c, c0, P0, idx, W, w_on=w_on)
+    xbar_new, xsqbar_new, W_new, conv = _ph_combine(
+        xn, prob, xbar_w, memberships, W, rho, wmask,
+        slot_slices=slot_slices)
     return xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj, \
         dual_obj
 
@@ -364,8 +358,19 @@ class PHBase(SPBase):
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
-        x/y/z warm-start across modes."""
+        x/y/z warm-start across modes. Always returns a genuine QPState:
+        a chunked solve stores a lazy _ChunkStateView at this key, which
+        satisfies the read-only consumers but not the solver's
+        ``_replace`` contract — materialize it (fresh factor, the view's
+        iterates as warm start) before handing it out."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+        st = self._qp_states.get(key)
+        if isinstance(st, _ChunkStateView):
+            factors, d = self._get_factors(prox_on, fixed)
+            st = qp_cold_state(factors, d)._replace(
+                x=st.x, yA=st.yA, yB=st.yB, zA=st.zA, zB=st.zB)
+            self._qp_states[key] = st
+            return st
         if key not in self._qp_states:
             factors, d = self._get_factors(prox_on, fixed)
             st = qp_cold_state(factors, d)

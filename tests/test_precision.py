@@ -219,6 +219,11 @@ def test_chunked_dive_candidates_integer_feasible():
                 dtype=jnp.float64)
     ph.solve_loop(w_on=False, prox_on=False)
     ph.W = ph.W_new
+    # a chunked PROX-ON solve first: it stores a lazy state view at the
+    # same mode key the prox-centered dive warm-starts from — the dive
+    # must materialize it, not crash on the view (review regression)
+    ph.solve_loop(w_on=True, prox_on=True)
+    ph.W = ph.W_new
     cands, feas = ph.dive_nonant_candidates(np.asarray(ph.xbar))
     assert feas.any()
     imask = ph.nonant_integer_mask
