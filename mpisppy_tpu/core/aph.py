@@ -28,6 +28,19 @@ TPU redesign:
   the async staleness model is carried entirely by **partial dispatch**:
   non-dispatched scenarios keep stale x (and lagged W/z when use_lag), which
   is exactly the reference's worker-view of a straggler rank.
+- The reference's OTHER listener purpose — wall-clock overlap of
+  reduction communication with solves (ref. listener_util.py:277-327) —
+  is carried by the execution model rather than a thread: under
+  sharding the collectives live INSIDE the jitted step, where XLA's
+  scheduler overlaps them with compute (the classic latency-hiding the
+  listener hand-rolled over MPI), and host-side control (dispatch
+  selection, window sync) runs while the device executes the
+  asynchronously dispatched solve. A Python listener thread would add
+  GIL contention to hide latency the compiler already hides; the one
+  genuinely host-synchronous point — phi-based dispatch needs last
+  iteration's phis on host — is inherent to data-dependent dispatch,
+  exactly as the reference blocks on its SecondReduce before
+  dispatching (ref. aph.py:552-669).
 - Dispatch = a boolean mask over the scenario axis. The batch solves as one
   SIMD program; non-dispatched scenarios' solutions are simply not accepted
   (x, y keep their old values), costing nothing extra on the MXU.

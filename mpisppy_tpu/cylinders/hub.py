@@ -83,16 +83,23 @@ class Hub(SPCommunicator):
         advance their last-seen id — a non-bound window (e.g. a cut
         spoke's, consumed by a subclass) must not be marked read here, or
         a payload written between the subclass's read and this one is
-        silently lost."""
+        silently lost. A spoke typed BOTH outer and inner (the EF-MIP
+        spoke: one B&B yields dual bound AND incumbent) publishes a
+        2-value window [outer, inner]; NaN entries mean "no value yet"
+        and lose every bound comparison harmlessly."""
         for i, sp in enumerate(self.spokes):
             is_outer = i in self.outer_bound_spoke_indices
-            if not is_outer and i not in self.inner_bound_spoke_indices:
+            is_inner = i in self.inner_bound_spoke_indices
+            if not is_outer and not is_inner:
                 continue
             values, wid = sp.my_window.read()
             if wid <= self._spoke_last_ids[i]:
                 continue
             self._spoke_last_ids[i] = wid
-            if is_outer:
+            if is_outer and is_inner:
+                self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
+                self.InnerBoundUpdate(values[1], sp.converger_spoke_char)
+            elif is_outer:
                 self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
             else:
                 self.InnerBoundUpdate(values[0], sp.converger_spoke_char)

@@ -105,11 +105,11 @@ def spoke_classes(kind: str):
                                             SlamDownHeuristic)
     from ..cylinders.fwph_spoke import FrankWolfeOuterBound
     from ..cylinders.cross_scen_spoke import CrossScenarioCutSpoke
-    from ..cylinders.ef_bounder import EFMipInnerBound
+    from ..cylinders.ef_bounder import EFMipBound
 
     return {
         "lagrangian": (LagrangianOuterBound, PHBase),
-        "efmip": (EFMipInnerBound, PHBase),
+        "efmip": (EFMipBound, PHBase),
         "lagranger": (LagrangerOuterBound, PHBase),
         "xhatshuffle": (XhatShuffleInnerBound, PHBase),
         "xhatlooper": (XhatLooperInnerBound, PHBase),
