@@ -25,18 +25,19 @@ Prints one JSON line per metric:
    exists): ~1.65 s/iter at 10 scenarios, scenario-proportional =>
    ~165 s/iter.
 
-3. uc10_time_to_1pct_gap_seconds — the BASELINE.json headline: a full
-   cylinder wheel (PH hub + Lagrangian outer-bound spoke + xhatshuffle
-   inner-bound spoke) on INTEGER-commitment UC, wall seconds until the
-   hub first observes rel gap <= 1%. Hub runs mixed precision (an f32
-   hub was measured to produce noise-dominated W that no Lagrangian
-   bound can use); the Lagrangian spoke uses the exact host-LP oracle;
-   the xhat spoke evaluates dived integer-feasible schedules. The reference
-   crossed 1% at wall 31.59 s (10scen_nofw.baseline.out, iteration-2
-   row: 0.0608%), startup included. Our number EXCLUDES jit compilation
-   (a warmup wheel runs first): with a persistent compile cache, steady
-   deployments pay compile once, while the tunnel used here recompiles
-   ~200 s/program per process — see the unit string.
+3. uc10_time_to_1pct_gap_seconds / uc10_time_to_halfpct_gap_seconds —
+   the BASELINE.json headline: a full cylinder wheel on INTEGER-
+   commitment UC, wall seconds until the hub first observes each rel
+   gap mark. Wheel = PH hub (device, mixed precision) + MIP-tight
+   Lagrangian spoke (LP-EF dual warm start + host HiGHS MILP oracle in
+   subprocesses) + the dual-purpose EF-MIP spoke (one host B&B
+   publishing incumbent AND dual bound). The reference crossed both
+   marks at wall 31.59 s — its iteration-2 Lagrangian bound was already
+   0.0608% (10scen_nofw.baseline.out), startup included. Our number
+   EXCLUDES jit compilation (a warmup wheel runs first): with a
+   persistent compile cache, steady deployments pay compile once, while
+   the tunnel used here recompiles ~200 s/program per process — see the
+   unit string.
 
 (The UC instances are seeded same-shape generators, not the reference's
 egret data files — the comparison is between execution models on the
@@ -44,9 +45,20 @@ same problem CLASS and size, stated per metric.)
 """
 
 import json
+import sys
 import time
 
 import jax
+
+_T0 = time.perf_counter()
+
+
+def _progress(msg):
+    """Stderr progress stamps (stdout carries the metric JSON lines):
+    tunneled-TPU compiles run minutes-long with zero output, and a
+    silent bench is indistinguishable from a hung one."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 UC_FAST = {
@@ -78,17 +90,21 @@ def bench_throughput():
     import numpy as np
 
     S = 128
+    _progress("throughput: building S=128 batch")
     ph = _build_ph(S, jax.numpy.float64,
                    extra={"subproblem_polish_chunk": 16,
                           "subproblem_precision": "mixed",
                           "subproblem_tail_iter": 1000,
                           "subproblem_max_iter": 2000,
                           "subproblem_segment": 500})
+    _progress("throughput: warmup solve 1 (compiles)")
     ph.solve_loop(w_on=False, prox_on=False)
     ph.W = ph.W_new
+    _progress("throughput: warmup solve 2")
     ph.solve_loop(w_on=True, prox_on=True)
     ph.W = ph.W_new
     jax.block_until_ready(ph.x)
+    _progress("throughput: timing 5 iterations")
 
     iters = 5
     t0 = time.perf_counter()
@@ -120,6 +136,7 @@ def bench_1024():
     # f64-involving UC solves on this TPU runtime; the membership
     # reductions run once over the full 1024 after the chunk loop.
     S2 = 1024
+    _progress("uc1024: building batch")
     ph2 = _build_ph(S2, jax.numpy.float64,
                     extra={"subproblem_chunk": 128,
                            "subproblem_precision": "mixed",
@@ -127,11 +144,14 @@ def bench_1024():
                            "subproblem_tail_iter": 1000,
                            "subproblem_segment": 500,
                            "subproblem_polish_chunk": 16})
+    _progress("uc1024: warmup solve 1 (8 chunks)")
     ph2.solve_loop(w_on=False, prox_on=False)
     ph2.W = ph2.W_new
+    _progress("uc1024: warmup solve 2")
     ph2.solve_loop(w_on=True, prox_on=True)
     ph2.W = ph2.W_new
     jax.block_until_ready(ph2.x)
+    _progress("uc1024: timing 3 iterations")
     t0 = time.perf_counter()
     for _ in range(3):
         ph2.solve_loop(w_on=True, prox_on=True)
@@ -201,18 +221,21 @@ def bench_time_to_gap():
     # SEQUENTIAL warmup — compiles every device program the wheel will
     # use (hub mixed-precision iter0/hot modes) without racing spoke
     # threads against the compiler; the oracle spokes run on host
+    _progress("time-to-gap: warmup wheel build")
     hdw, _ = vanilla.wheel_dicts(_gap_cfg(max_iterations=3))
     hub_opt = hdw["opt_class"](**hdw["opt_kwargs"])
     hub_opt.solve_loop(w_on=False, prox_on=False)
     hub_opt.W = hub_opt.W_new
     hub_opt.solve_loop(w_on=True, prox_on=True)
     del hub_opt
+    _progress("time-to-gap: warmup done; building timed wheel")
 
     # timed wheel on fresh engines (same shapes -> cached compiles);
     # 80 device iterations bound the wall should the 5e-5 gap target
     # somehow stay out of reach — the milestone marks land regardless
     hd, sds = vanilla.wheel_dicts(_gap_cfg(max_iterations=80))
     hd["hub_kwargs"]["options"]["gap_marks"] = (0.01, 0.005)
+    _progress("time-to-gap: spinning the wheel")
     t0 = time.perf_counter()
     res = spin_the_wheel(hd, sds)
     t_end = time.perf_counter()

@@ -1,0 +1,79 @@
+"""Run a few small end-to-end wheels and fail loudly on any bad bound.
+
+The analog of ref. examples/afew.py:26-55: farmer, sizes, and hydro
+drives with a ``badguys`` exit code — the quick full-stack smoke a
+user runs after install (the full sweep is the test suite).
+
+    python examples/afew.py
+"""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # repo-root import without install
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from mpisppy_tpu.core.ef import ExtensiveForm
+from mpisppy_tpu.utils.config import AlgoConfig, RunConfig, SpokeConfig
+from mpisppy_tpu.utils.sputils import spin_the_wheel
+from mpisppy_tpu.utils.vanilla import build_batch_for, wheel_dicts
+
+badguys = []
+
+
+def check(name, ok):
+    print(f"{name}: {'OK' if ok else 'FAIL'}")
+    if not ok:
+        badguys.append(name)
+
+
+def farmer_wheel():
+    cfg = RunConfig(
+        model="farmer", num_scens=3,
+        algo=AlgoConfig(default_rho=1.0, max_iterations=100,
+                        convthresh=-1.0, subproblem_max_iter=4000),
+        spokes=[SpokeConfig(kind="lagrangian"),
+                SpokeConfig(kind="xhatshuffle")],
+        rel_gap=5e-3)
+    wheel = spin_the_wheel(*wheel_dicts(cfg))
+    # EF optimum -108390: the sandwich must hold around it
+    check("farmer wheel",
+          wheel.best_outer_bound <= -108389.0 <= wheel.best_inner_bound)
+
+
+def sizes_ef():
+    cfg = RunConfig(model="sizes", num_scens=3,
+                    model_kwargs={"scenario_count": 3})
+    ef = ExtensiveForm(build_batch_for(cfg))
+    obj, _ = ef.solve_extensive_form()
+    # LP relaxation sits below the reference's 220000 2-sig MIP value
+    check("sizes EF", 200000.0 < obj < 230000.0)
+
+
+def hydro_wheel():
+    cfg = RunConfig(
+        model="hydro", model_kwargs={"branching_factors": (3, 3)},
+        num_scens=9,
+        algo=AlgoConfig(default_rho=1.0, max_iterations=50,
+                        convthresh=-1.0, subproblem_max_iter=3000),
+        spokes=[SpokeConfig(kind="lagrangian"),
+                SpokeConfig(kind="xhatspecific")],
+        rel_gap=2e-2)
+    wheel = spin_the_wheel(*wheel_dicts(cfg))
+    check("hydro wheel (3-stage)",
+          wheel.best_outer_bound <= wheel.best_inner_bound + 1e-6)
+
+
+if __name__ == "__main__":
+    farmer_wheel()
+    sizes_ef()
+    hydro_wheel()
+    if badguys:
+        print("badguys:", badguys)
+        sys.exit(1)
+    print("all good")
+    sys.exit(0)

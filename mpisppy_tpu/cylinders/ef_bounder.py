@@ -46,14 +46,24 @@ class EFMipBound(Spoke):
                              ConvergerSpokeType.INNER_BOUND)
     converger_spoke_char = "E"
 
+    @staticmethod
+    def payload_length(S, K) -> int:
+        return 2            # [dual (outer), incumbent (inner)]
+
     def __init__(self, spbase_object, options=None, trace_prefix=None):
         super().__init__(spbase_object, options, trace_prefix)
         self.best_xhat = None
-        self.outer_bound = None
         self._pool = None
+        # live bound trace like _BoundSpoke's, with both window values
+        # (ref. spoke.py:140-153 trace_prefix)
+        self._trace_path = (f"{trace_prefix}{type(self).__name__}.csv"
+                            if trace_prefix else None)
+        if self._trace_path:
+            with open(self._trace_path, "w") as f:
+                f.write("time,outer,inner\n")
 
     def local_window_length(self) -> int:
-        return 2            # [dual (outer), incumbent (inner)]
+        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
 
     def _solve_ef(self):
         """Returns (dual_bound, incumbent_obj, x_ef) with None entries
@@ -96,11 +106,14 @@ class EFMipBound(Spoke):
                              for s in range(b.S)])
             self.best_xhat = self.opt.round_nonants(xhat)
             self.bound = inc
-        self.outer_bound = dual
         if dual is not None or inc is not None:
             self.spoke_to_hub(np.array(
                 [np.nan if dual is None else dual,
                  np.nan if inc is None else inc]))
+            if self._trace_path:
+                import time
+                with open(self._trace_path, "a") as f:
+                    f.write(f"{time.monotonic()},{dual},{inc}\n")
         # solved (or failed): idle on the kill signal like a looper
         # whose candidate stream is exhausted
         while not self.got_kill_signal():

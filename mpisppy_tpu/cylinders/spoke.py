@@ -29,6 +29,15 @@ class Spoke(SPCommunicator):
     converger_spoke_types = ()
     converger_spoke_char = "?"
 
+    @staticmethod
+    def payload_length(S, K) -> int:
+        """Spoke→hub window length as a function of batch dims — the
+        ONE layout definition: the instance's local_window_length and
+        the multi-process SpokeProxy (which never holds an instance)
+        must size the same shared buffer from it. Default: a single
+        bound value."""
+        return 1
+
     def __init__(self, spbase_object, options=None, trace_prefix=None):
         super().__init__(spbase_object, options)
         self.hub_window: Window | None = None   # hub writes, we read
@@ -116,7 +125,7 @@ class _BoundSpoke(Spoke):
                 f.write("time,bound\n")
 
     def local_window_length(self) -> int:
-        return 1
+        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
 
     def update_bound(self, value: float):
         self.bound = float(value)

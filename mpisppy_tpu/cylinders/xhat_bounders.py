@@ -89,10 +89,8 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
         out = np.array(np.asarray(X), dtype=np.float64, copy=True)
         filled = np.zeros(self.opt.batch.S, bool)
         if self.options.get("xhat_oracle_candidates", False):
-            res = self._oracle_candidates(X)
-            if res is not None:
-                out, filled = res
-            elif self.killed():
+            filled = self._oracle_candidates(out)
+            if self.killed():
                 return out
         if not filled.all() and self.options.get("xhat_dive_candidates",
                                                  True):
@@ -105,13 +103,14 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             out[take] = np.asarray(cands)[take]
         return out
 
-    def _oracle_candidates(self, X):
-        """First ``xhat_scen_limit`` scenarios' MILP-exact nonant
-        blocks. Returns (cands (S,K), filled (S,) bool) or None on
-        oracle failure/kill (failure logged once; the pool is not
-        rebuilt after a construction error)."""
+    def _oracle_candidates(self, out):
+        """Fill ``out`` rows 0..xhat_scen_limit-1 in place with the
+        scenarios' MILP-exact nonant blocks; returns the (S,) filled
+        mask (all-False on oracle failure/kill — failure logged once;
+        the pool is not rebuilt after a construction error)."""
+        filled = np.zeros(self.opt.batch.S, bool)
         if self._oracle_pool is False:      # earlier construction failed
-            return None
+            return filled
         limit = min(int(self.options.get("xhat_scen_limit", 3)),
                     self.opt.batch.S)
         try:
@@ -137,18 +136,16 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
                        f"unavailable ({e!r}); falling back to dives")
             if self._oracle_pool is None:
                 self._oracle_pool = False   # don't re-pay construction
-            return None
+            return filled
         if res is None:
-            return None
+            return filled
         xs = res[3]
         idx = np.asarray(self.opt.batch.nonant_idx)
-        out = np.array(np.asarray(X), dtype=np.float64, copy=True)
-        filled = np.zeros(self.opt.batch.S, bool)
         for s in range(len(xs)):
             if xs[s] is not None:
                 out[s] = xs[s][1][idx]
                 filled[s] = True
-        return (out, filled) if filled.any() else None
+        return filled
 
     def main(self):
         while not self.got_kill_signal():

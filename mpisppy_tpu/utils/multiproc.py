@@ -58,11 +58,11 @@ class SpokeProxy:
         return self._S * self._K * (int(has_w) + int(has_x))
 
     def local_window_length(self) -> int:
-        if self.is_cut_spoke:
-            # the spoke class owns its payload layout — sizing it here
-            # too would let the two windows drift apart
-            return self._spoke_cls.payload_length(self._S, self._K)
-        return 1          # bound spokes publish [bound]
+        # the spoke class owns its payload layout (Spoke.payload_length:
+        # 1 for bound spokes, 2 for the dual-typed EF-MIP spoke,
+        # S*(1+K) for the cut spoke) — sizing it here too would let the
+        # hub-side and child-side windows drift apart
+        return self._spoke_cls.payload_length(self._S, self._K)
 
 
 def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):

@@ -61,6 +61,34 @@ def test_two_process_farmer_wheel():
 
 
 @pytest.mark.slow
+def test_efmip_process_wheel():
+    """The dual-typed EF-MIP spoke as a child process: its 2-value
+    window must be sized identically on both sides (the proxy sizes
+    from the class's payload_length) and the hub must consume BOTH
+    bound sides from it."""
+    cfg = RunConfig(
+        model="uc", num_scens=3,
+        model_kwargs={"num_gens": 3, "num_hours": 6,
+                      "relax_integrality": False},
+        algo=AlgoConfig(default_rho=50.0, max_iterations=4000,
+                        convthresh=-1.0, subproblem_max_iter=1500,
+                        subproblem_eps=1e-7),
+        spokes=[SpokeConfig(kind="efmip",
+                            options={"efmip_time_limit": 60.0,
+                                     "efmip_gap": 1e-5})],
+        rel_gap=1e-4,
+    )
+    hub = spin_the_wheel_processes(cfg, join_timeout=180.0)
+    assert hub._spoke_last_ids[0] > 1, "no EF bound payload consumed"
+    assert np.isfinite(hub.BestOuterBound)
+    assert np.isfinite(hub.BestInnerBound)
+    assert hub.BestOuterBound <= hub.BestInnerBound + 1e-6
+    # the EF B&B at gap 1e-5 certifies a tight sandwich
+    rel = (hub.BestInnerBound - hub.BestOuterBound) / abs(hub.BestInnerBound)
+    assert rel < 1e-3
+
+
+@pytest.mark.slow
 def test_cross_scenario_process_wheel():
     """The cross-scenario cut spoke as a CHILD PROCESS (VERDICT r2
     missing #3: it was in-process only): the hub must install cut rows
