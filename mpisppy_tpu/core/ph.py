@@ -120,7 +120,8 @@ def _ph_combine(xn, prob, xbar_w, memberships, W, rho, wmask, *,
 
 def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                  sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
-                 tail_iter, stall_rel, segment, polish_hot, polish_chunk):
+                 tail_iter, stall_rel, segment, polish_hot, polish_chunk,
+                 segment_lo=None):
     """The ONE precision-policy + solver dispatch, shared by the fused
     step and the chunked loop (a second copy would silently drift).
 
@@ -147,7 +148,7 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                               polish_chunk=polish_chunk,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
                               stall_rel=stall_rel, segment=segment,
-                              polish=do_polish)
+                              segment_lo=segment_lo, polish=do_polish)
     return qp_solve_segmented(factors, d, q, qp_state,
                               max_iter=sub_max_iter, segment=segment,
                               eps_abs=e_pri, eps_rel=e_pri,
@@ -161,7 +162,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
              polish_chunk, precision="native", tail_iter=1000,
              sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
-             segment=500, polish_hot=True):
+             segment=500, polish_hot=True, segment_lo=None):
     """The PH iteration: batched subproblem solve + Compute_Xbar +
     Update_W + convergence + objectives + certified dual bound, staged as
     THREE jitted programs (assemble / solve / reduce) rather than one
@@ -184,7 +185,8 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
         sub_max_iter=sub_max_iter, sub_eps=sub_eps,
         sub_eps_hot=sub_eps_hot, sub_eps_dua_hot=sub_eps_dua_hot,
         tail_iter=tail_iter, stall_rel=stall_rel, segment=segment,
-        polish_hot=polish_hot, polish_chunk=polish_chunk)
+        polish_hot=polish_hot, polish_chunk=polish_chunk,
+        segment_lo=segment_lo)
     wmask = None if wscale is None else wscale > 0
     (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
      dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
@@ -249,8 +251,12 @@ class PHBase(SPBase):
         _hd = opts.get("subproblem_eps_dua_hot", None)
         self.sub_eps_dua_hot = None if _hd is None else float(_hd)
         self.sub_stall_rel = float(opts.get("subproblem_stall_rel", 0.0))
-        # per-device-call iteration segment (watchdog-safe executions)
+        # per-device-call iteration segment (watchdog-safe executions);
+        # the f32 bulk phase of mixed solves may use a LONGER segment
+        # (the watchdog ceiling binds f64-involving executions only)
         self.sub_segment = int(opts.get("subproblem_segment", 500))
+        _sl = opts.get("subproblem_segment_lo", None)
+        self.sub_segment_lo = None if _sl is None else int(_sl)
         self.sub_polish_hot = bool(opts.get("subproblem_polish_hot", True))
         if self.sub_precision == "mixed" and self.dtype != jnp.float64:
             raise ValueError("subproblem_precision='mixed' needs dtype="
@@ -482,7 +488,8 @@ class PHBase(SPBase):
                 sub_eps_dua_hot=self.sub_eps_dua_hot,
                 tail_iter=self.sub_tail_iter,
                 stall_rel=self.sub_stall_rel, segment=self.sub_segment,
-                polish_hot=self.sub_polish_hot, polish_chunk=polish_chunk)
+                polish_hot=self.sub_polish_hot, polish_chunk=polish_chunk,
+                segment_lo=self.sub_segment_lo)
             states[ci] = st
             xn, base, solved, dual = _ph_chunk_objs(
                 x, yA, yB, d_c, q_c, self.c[idx_c], self.c0[idx_c],
@@ -592,7 +599,8 @@ class PHBase(SPBase):
             sub_eps_hot=self.sub_eps_hot,
             sub_eps_dua_hot=self.sub_eps_dua_hot,
             stall_rel=self.sub_stall_rel, segment=self.sub_segment,
-            polish_hot=self.sub_polish_hot)
+            polish_hot=self.sub_polish_hot,
+            segment_lo=self.sub_segment_lo)
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB

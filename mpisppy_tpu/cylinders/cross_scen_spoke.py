@@ -30,12 +30,9 @@ class CrossScenarioCutSpoke(Spoke):
     @staticmethod
     def payload_length(S, K) -> int:
         """Cut-window layout: S rows of [const, *K nonant coefs]. ONE
-        source of truth — the multi-process proxy sizes the hub-side
-        shared window from this too."""
+        source of truth — the instance's local_window_length and the
+        multi-process proxy both size from it."""
         return S * (1 + K)
-
-    def local_window_length(self) -> int:
-        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
 
     def _select_candidate(self, X):
         """x̂ = the scenario row farthest (L2) from the prob-weighted mean

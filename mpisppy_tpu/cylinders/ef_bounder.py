@@ -54,16 +54,7 @@ class EFMipBound(Spoke):
         super().__init__(spbase_object, options, trace_prefix)
         self.best_xhat = None
         self._pool = None
-        # live bound trace like _BoundSpoke's, with both window values
-        # (ref. spoke.py:140-153 trace_prefix)
-        self._trace_path = (f"{trace_prefix}{type(self).__name__}.csv"
-                            if trace_prefix else None)
-        if self._trace_path:
-            with open(self._trace_path, "w") as f:
-                f.write("time,outer,inner\n")
-
-    def local_window_length(self) -> int:
-        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
+        self._init_trace("time,outer,inner")
 
     def _solve_ef(self):
         """Returns (dual_bound, incumbent_obj, x_ef) with None entries
@@ -112,8 +103,10 @@ class EFMipBound(Spoke):
                  np.nan if inc is None else inc]))
             if self._trace_path:
                 import time
+                d = float("nan") if dual is None else dual
+                i = float("nan") if inc is None else inc
                 with open(self._trace_path, "a") as f:
-                    f.write(f"{time.monotonic()},{dual},{inc}\n")
+                    f.write(f"{time.monotonic()},{d},{i}\n")
         # solved (or failed): idle on the kill signal like a looper
         # whose candidate stream is exhausted
         while not self.got_kill_signal():

@@ -81,6 +81,20 @@ class Spoke(SPCommunicator):
         subproblem solves, ref. spoke.py:101-111)."""
         return self.hub_window.read_id() == Window.KILL
 
+    def local_window_length(self) -> int:
+        # payload_length is the ONE override point for spoke→hub layout
+        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
+
+    def _init_trace(self, header):
+        """Create the live trace CSV when a trace_prefix was given
+        (ref. spoke.py:140-153): one naming scheme for every spoke
+        kind; subclasses choose the header/columns."""
+        self._trace_path = (f"{self._trace_prefix}{type(self).__name__}"
+                            ".csv" if self._trace_prefix else None)
+        if self._trace_path:
+            with open(self._trace_path, "w") as f:
+                f.write(header + "\n")
+
     def main(self):
         raise NotImplementedError
 
@@ -118,14 +132,7 @@ class _BoundSpoke(Spoke):
 
     def __init__(self, spbase_object, options=None, trace_prefix=None):
         super().__init__(spbase_object, options, trace_prefix)
-        self._trace_path = (f"{trace_prefix}{type(self).__name__}.csv"
-                            if trace_prefix else None)
-        if self._trace_path:
-            with open(self._trace_path, "w") as f:
-                f.write("time,bound\n")
-
-    def local_window_length(self) -> int:
-        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
+        self._init_trace("time,bound")
 
     def update_bound(self, value: float):
         self.bound = float(value)

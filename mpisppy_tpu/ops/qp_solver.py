@@ -588,7 +588,7 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
                    eps_abs=1e-6, eps_rel=1e-6, alpha=1.6, adaptive_rho=True,
                    polish=True, polish_iters=12, polish_chunk=0,
                    eps_abs_dua=None, eps_rel_dua=None, stall_rel=0.0,
-                   segment=500):
+                   segment=500, segment_lo=None):
     """Precision-escalated solve: an f32 bulk phase (MXU-friendly — the
     thousands of ADMM matmuls run at accelerator speed) followed by an f64
     tail (one refactorization + a few hundred iterations + the polish).
@@ -609,10 +609,13 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     is bounded separately by ``subproblem_tail_iter``. rho adaptation
     stays on in both phases (the tail refactorizes in f64 when the
     ratio moves >5x — worth it when the f32 handoff mis-scaled rho).
-    Both phases run SEGMENTED (at most ``segment`` iterations per
-    device execution) for the same watchdog reason as
-    qp_solve_segmented. Returns the same (state, x, yA, yB) contract as
-    qp_solve, with the state in f64.
+    Both phases run SEGMENTED for the same watchdog reason as
+    qp_solve_segmented; ``segment_lo`` (default: ``segment``) sets the
+    f32 phase's segment separately — the measured watchdog ceiling
+    binds f64-involving executions only, and on high-latency device
+    links (tunneled TPUs) fewer, longer f32 calls cut the dominant
+    per-dispatch overhead. Returns the same (state, x, yA, yB) contract
+    as qp_solve, with the state in f64.
     """
     lo = jnp.float32
     f_lo = _cast_floats(factors, lo)
@@ -629,18 +632,19 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     eps_rel_lo_dua = jnp.maximum(
         jnp.asarray(eps_rel if eps_rel_dua is None else eps_rel_dua, lo),
         1e-2)
+    seg_lo = int(segment_lo) if segment_lo else segment
     lo_total = 0
     while lo_total < max_iter:
         # constant segment size — see qp_solve_segmented on why the
         # remainder must not become a fresh static max_iter
         st_lo, _, _, _ = _solve_lo_jit(f_lo, d_lo, q.astype(lo), st_lo,
-                                       segment, check_every, eps_lo,
+                                       seg_lo, check_every, eps_lo,
                                        eps_rel_lo, alpha, adaptive_rho,
                                        polish_iters, eps_rel_lo_dua,
                                        stall_rel)
         ran = int(st_lo.iters)
         lo_total += ran
-        if ran < segment:
+        if ran < seg_lo:
             break
     dt_hi = state.x.dtype
     rho_hi = st_lo.rho_scale.astype(dt_hi)

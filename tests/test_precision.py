@@ -120,6 +120,26 @@ def test_ph_precision_mixed_option():
     assert float(np.asarray(st.pri_rel).max()) < 1e-3
 
 
+def test_mixed_segment_lo_matches_default():
+    """A longer f32 segment (subproblem_segment_lo — the dispatch-count
+    lever for high-latency device links) must not change the solution
+    quality the mixed escalation delivers."""
+    b = _uc_batch()
+    data, q, factors = _qp(b, jnp.float64)
+    st1 = qp_cold_state(factors, data)
+    st1, x1, *_ = qp_solve_mixed(factors, data, q, st1, max_iter=1500,
+                                 tail_iter=1500, eps_abs=1e-6,
+                                 eps_rel=1e-6, segment=250)
+    st2 = qp_cold_state(factors, data)
+    st2, x2, *_ = qp_solve_mixed(factors, data, q, st2, max_iter=1500,
+                                 tail_iter=1500, eps_abs=1e-6,
+                                 eps_rel=1e-6, segment=250,
+                                 segment_lo=1500)
+    assert float(st2.pri_rel.max()) < 1e-3
+    scale = float(jnp.max(jnp.abs(x1))) + 1.0
+    assert float(jnp.max(jnp.abs(x1 - x2))) / scale < 1e-3
+
+
 def test_ph_precision_mixed_requires_f64():
     with pytest.raises(ValueError):
         PHBase(_uc_batch(), {"subproblem_precision": "mixed"},
