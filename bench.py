@@ -28,7 +28,8 @@ Prints one JSON line per metric:
 3. uc10_time_to_1pct_gap_seconds / uc10_time_to_halfpct_gap_seconds —
    the BASELINE.json headline: a full cylinder wheel on INTEGER-
    commitment UC, wall seconds until the hub first observes each rel
-   gap mark. Wheel = PH hub (device, mixed precision) + MIP-tight
+   gap mark. Wheel = PH hub (device, pure f32 — the certificate
+   never touches hub numerics) + MIP-tight
    Lagrangian spoke (LP-EF dual warm start + host HiGHS MILP oracle in
    subprocesses) + the dual-purpose EF-MIP spoke (one host B&B
    publishing incumbent AND dual bound). The reference crossed both
@@ -183,12 +184,20 @@ def _gap_cfg(max_iterations):
         algo=AlgoConfig(default_rho=100.0, max_iterations=max_iterations,
                         convthresh=-1.0, subproblem_max_iter=2000,
                         subproblem_eps=1e-6),
-        hub_options={**UC_FAST, "dtype": "float64",
-                     "subproblem_precision": "mixed",
+        # PURE-F32 HUB: in the round-3 bound architecture the gap
+        # certificate never touches hub numerics — the Lagrangian spoke
+        # warm-starts at the LP-EF dual optimum and the EF-MIP spoke
+        # certifies both sides, all in exact host arithmetic — so the
+        # accelerator runs the consensus search at f32 speed with no
+        # f64 tail/polish at all (r2 needed a mixed hub only because
+        # its bounds were built FROM hub W).
+        hub_options={**UC_FAST, "dtype": "float32",
+                     "subproblem_eps": 1e-4,
+                     "subproblem_eps_hot": 1e-3,
+                     "subproblem_eps_dua_hot": 1e-2,
                      "subproblem_max_iter": 2000,
-                     "subproblem_tail_iter": 1200,
-                     "subproblem_segment": 500,
-                     "subproblem_segment_lo": 2000,
+                     "subproblem_segment": 2000,
+                     "subproblem_polish_hot": False,
                      "iter0_feas_tol": 5e-3,
                      # per-mode solve-time splits printed post-wheel so
                      # the iteration cadence is accounted for (VERDICT
@@ -226,7 +235,7 @@ def bench_time_to_gap():
     from mpisppy_tpu.utils.sputils import spin_the_wheel
 
     # SEQUENTIAL warmup — compiles every device program the wheel will
-    # use (hub mixed-precision iter0/hot modes) without racing spoke
+    # use (the f32 hub's iter0/hot modes) without racing spoke
     # threads against the compiler; the oracle spokes run on host
     _progress("time-to-gap: warmup wheel build")
     hdw, _ = vanilla.wheel_dicts(_gap_cfg(max_iterations=3))
@@ -272,8 +281,8 @@ def bench_time_to_gap():
         print(json.dumps({
             "metric": metric,
             "value": round(t_gap, 1),
-            "unit": f"s to rel gap <= {100 * mark:g}% (PH hub mixed-"
-                    "precision on device + MIP-tight Lagrangian spoke "
+            "unit": f"s to rel gap <= {100 * mark:g}% (pure-f32 PH "
+                    "hub on device + MIP-tight Lagrangian spoke "
                     "(LP-EF dual warm start, host HiGHS oracle "
                     "subprocesses) + host EF-MIP incumbent and "
                     "dual-bound spokes, integer UC, compile excluded "
@@ -283,8 +292,8 @@ def bench_time_to_gap():
 
 
 def main():
-    # f64 is needed by the mixed-precision spokes in metric 3; the f32
-    # engines in metrics 1-2 pass explicit dtypes throughout
+    # x64 is needed by the f64/mixed engines in metrics 1-2 and the
+    # f64 bound spokes in metric 3; per-cylinder dtypes are explicit
     jax.config.update("jax_enable_x64", True)
     bench_throughput()
     bench_1024()

@@ -127,8 +127,9 @@ class _BoundSpoke(Spoke):
     """Publishes [bound]; CSV-style (time, bound) trace kept in memory and
     dumpable via ``write_trace``. With ``trace_prefix`` set, a live
     ``<prefix><SpokeClass>.csv`` is appended on every bound update
-    (ref. spoke.py:135-188 trace_prefix) — only bound spokes write one,
-    so the file lives here, not in the base Spoke."""
+    (ref. spoke.py:135-188 trace_prefix) — the file machinery is the
+    base class's _init_trace; this class picks the (time, bound)
+    columns."""
 
     def __init__(self, spbase_object, options=None, trace_prefix=None):
         super().__init__(spbase_object, options, trace_prefix)

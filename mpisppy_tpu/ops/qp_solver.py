@@ -632,7 +632,10 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     eps_rel_lo_dua = jnp.maximum(
         jnp.asarray(eps_rel if eps_rel_dua is None else eps_rel_dua, lo),
         1e-2)
-    seg_lo = int(segment_lo) if segment_lo else segment
+    if segment_lo is not None and int(segment_lo) <= 0:
+        raise ValueError("segment_lo must be positive (None = use "
+                         "`segment` for both phases)")
+    seg_lo = segment if segment_lo is None else int(segment_lo)
     lo_total = 0
     while lo_total < max_iter:
         # constant segment size — see qp_solve_segmented on why the
