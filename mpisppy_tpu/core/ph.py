@@ -37,7 +37,8 @@ from .. import global_toc
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import (QPData, QPState, qp_setup, qp_solve,
                              qp_solve_mixed, qp_solve_segmented,
-                             qp_cold_state, qp_dual_objective)
+                             qp_cold_state, qp_dual_objective,
+                             qp_reset_rho)
 from .spbase import SPBase, compute_xbar
 
 
@@ -118,6 +119,12 @@ def _ph_combine(xn, prob, xbar_w, memberships, W, rho, wmask, *,
     return xbar_new, xsqbar_new, W_new, conv
 
 
+def _hot_eps(prox_on, sub_eps, sub_eps_hot):
+    """The effective primal tolerance of a solve — THE policy both the
+    dispatch and any quality gate (chunk recovery) must share."""
+    return sub_eps_hot if (prox_on and sub_eps_hot is not None) else sub_eps
+
+
 def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                  sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
                  tail_iter, stall_rel, segment, polish_hot, polish_chunk,
@@ -136,7 +143,7 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
     point on UC). Defaults keep the strict contract everywhere. The
     polish serves DUAL accuracy (certified bounds) and final primal
     refinement, so prox-on solves can skip it (subproblem_polish_hot)."""
-    e_pri = sub_eps_hot if (prox_on and sub_eps_hot is not None) else sub_eps
+    e_pri = _hot_eps(prox_on, sub_eps, sub_eps_hot)
     e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
         else sub_eps
     do_polish = polish_hot or not prox_on
@@ -301,6 +308,9 @@ class PHBase(SPBase):
         self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
         self._fixed_mask = jnp.zeros((S, K), bool)   # fixer/xhat support
         self._fixed_vals = jnp.zeros((S, K), t)
+        # chunks whose reset-rho recovery retry didn't help, per mode
+        # key (see _solve_loop_chunked pass 2)
+        self._chunk_no_retry = {}
         # timing splits (ref. spbase.py:261-269 display_timing, a
         # secret-menu option there too): wall seconds per solve_loop
         # call, keyed by mode; off by default (the timing sync would
@@ -361,6 +371,8 @@ class PHBase(SPBase):
             cache.pop(("fixed", True), None)
             cache.pop(("chunks", True), None)
             cache.pop(("chunks", ("fixed", True)), None)
+        # a new rho deserves fresh recovery chances
+        self._chunk_no_retry.clear()
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
@@ -468,8 +480,18 @@ class PHBase(SPBase):
         slices = self._chunk_index(chunk)
         states = self._ensure_chunk_states(key, factors, data, slices)
         polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
-        parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
-                                 "dual")}
+        kw = dict(prox_on=bool(prox_on), precision=self.sub_precision,
+                  sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
+                  sub_eps_hot=self.sub_eps_hot,
+                  sub_eps_dua_hot=self.sub_eps_dua_hot,
+                  tail_iter=self.sub_tail_iter,
+                  stall_rel=self.sub_stall_rel, segment=self.sub_segment,
+                  polish_hot=self.sub_polish_hot,
+                  polish_chunk=polish_chunk,
+                  segment_lo=self.sub_segment_lo)
+        # pass 1 — SOLVES ONLY, no host syncs: every chunk's work is
+        # enqueued under JAX async dispatch before anything blocks
+        solved_chunks = []
         for ci, (idx_c, real) in enumerate(slices):
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
                                 lb=data.lb[idx_c], ub=data.ub[idx_c])
@@ -480,16 +502,37 @@ class PHBase(SPBase):
                                     self._fixed_mask[idx_c],
                                     self._fixed_vals[idx_c], ws,
                                     w_on=bool(w_on), prox_on=bool(prox_on))
-            st, x, yA, yB = _solver_call(
-                factors, d_c, q_c, states[ci], prox_on=bool(prox_on),
-                precision=self.sub_precision,
-                sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
-                sub_eps_hot=self.sub_eps_hot,
-                sub_eps_dua_hot=self.sub_eps_dua_hot,
-                tail_iter=self.sub_tail_iter,
-                stall_rel=self.sub_stall_rel, segment=self.sub_segment,
-                polish_hot=self.sub_polish_hot, polish_chunk=polish_chunk,
-                segment_lo=self.sub_segment_lo)
+            st, x, yA, yB = _solver_call(factors, d_c, q_c, states[ci],
+                                         **kw)
+            solved_chunks.append([st, x, yA, yB, d_c, q_c])
+        # pass 2 — bounded recovery: a chunk whose warm-started rho
+        # trajectory went pathological (per-chunk shared rho adapts on
+        # chunk statistics) can exhaust its budget far from
+        # feasibility. ONE sync point reads every chunk's residual;
+        # flagged chunks retry once from a reset rho/factor. The NaN
+        # blowup case must flag too, and a chunk whose reset retry
+        # didn't help is blacklisted — a genuinely hard chunk must not
+        # double every future iteration's cost.
+        thr = max(100 * _hot_eps(bool(prox_on), self.sub_eps,
+                                 self.sub_eps_hot), 1e-2)
+        no_retry = self._chunk_no_retry.setdefault(key, set())
+        for ci, rec in enumerate(solved_chunks):
+            m = float(jnp.max(rec[0].pri_rel))
+            if (m <= thr) or ci in no_retry:
+                continue
+            st2, x2, yA2, yB2 = _solver_call(
+                factors, rec[4], rec[5], qp_reset_rho(factors, rec[0]),
+                **kw)
+            m2 = float(jnp.max(st2.pri_rel))
+            if m2 < m or not np.isfinite(m):
+                rec[:4] = [st2, x2, yA2, yB2]
+            if not (m2 <= thr):
+                no_retry.add(ci)
+        # pass 3 — per-chunk objectives on the accepted solutions
+        parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
+                                 "dual")}
+        for ci, (idx_c, real) in enumerate(slices):
+            st, x, yA, yB, d_c, q_c = solved_chunks[ci]
             states[ci] = st
             xn, base, solved, dual = _ph_chunk_objs(
                 x, yA, yB, d_c, q_c, self.c[idx_c], self.c0[idx_c],

@@ -270,6 +270,16 @@ def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
                      rho_A=rho_A, rho_b=rho_b)
 
 
+def qp_reset_rho(factors: QPFactors, state: QPState) -> QPState:
+    """Reset the adaptive-rho trajectory: rho_scale back to 1 with the
+    matching refactorization — the recovery move for a warm-started
+    state whose adaptation went pathological (the same pattern
+    qp_cold_state and the mixed escalation's phase handoffs use).
+    Iterates are kept; only the stepsize/factor reset."""
+    ones = jnp.ones_like(state.rho_scale)
+    return state._replace(rho_scale=ones, L=_factorize(factors, ones))
+
+
 @jax.jit
 def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
     S, m = data.l.shape

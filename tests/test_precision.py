@@ -253,6 +253,29 @@ def test_chunked_dive_candidates_integer_feasible():
     assert inc is not None and np.isfinite(inc)
 
 
+def test_chunked_rho_pathology_recovery():
+    """A chunk whose warm-started rho_scale went pathological (per-chunk
+    shared rho adapts on chunk statistics) must be retried from a reset
+    factorization instead of accepting a grossly unconverged solve."""
+    from mpisppy_tpu.ops.qp_solver import _factorize
+
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 1200,
+            "subproblem_eps": 1e-6, "subproblem_chunk": 4}
+    ph = PHBase(_uc_batch(S=8), opts, dtype=jnp.float64)
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    ph.solve_loop(w_on=True, prox_on=True)
+    # poison chunk 0's rho so its next warm-started solve stalls
+    sts = ph._qp_states[("chunks", True)]
+    factors, _ = ph._get_factors(True)
+    bad_rho = jnp.full_like(sts[0].rho_scale, 1e-6)
+    sts[0] = sts[0]._replace(rho_scale=bad_rho,
+                             L=_factorize(factors, bad_rho))
+    ph.solve_loop(w_on=True, prox_on=True)
+    pri = np.asarray(ph._qp_states[True].pri_rel)
+    assert pri.max() < 1e-2, f"recovery did not engage: {pri.max():.1e}"
+
+
 def test_chunked_requires_shared_structure():
     from mpisppy_tpu.models import netdes
 
