@@ -95,9 +95,14 @@ def bench_throughput():
     ph = _build_ph(S, jax.numpy.float64,
                    extra={"subproblem_polish_chunk": 16,
                           "subproblem_precision": "mixed",
-                          "subproblem_tail_iter": 1000,
+                          # measured: a ~300-iteration f64 tail +
+                          # polish reaches the same post-polish quality
+                          # as a 1000-iteration tail (the polish does
+                          # the accuracy work); the tail is the
+                          # dominant per-iteration device cost
+                          "subproblem_tail_iter": 300,
                           "subproblem_max_iter": 2000,
-                          "subproblem_segment": 500,
+                          "subproblem_segment": 150,
                           "subproblem_segment_lo": 2000})
     _progress("throughput: warmup solve 1 (compiles)")
     ph.solve_loop(w_on=False, prox_on=False)
@@ -143,8 +148,8 @@ def bench_1024():
                     extra={"subproblem_chunk": 128,
                            "subproblem_precision": "mixed",
                            "subproblem_max_iter": 2000,
-                           "subproblem_tail_iter": 1000,
-                           "subproblem_segment": 500,
+                           "subproblem_tail_iter": 300,
+                           "subproblem_segment": 150,
                            "subproblem_segment_lo": 2000,
                            "subproblem_polish_chunk": 16})
     _progress("uc1024: warmup solve 1 (8 chunks)")

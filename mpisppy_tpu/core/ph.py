@@ -489,8 +489,11 @@ class PHBase(SPBase):
                   polish_hot=self.sub_polish_hot,
                   polish_chunk=polish_chunk,
                   segment_lo=self.sub_segment_lo)
-        # pass 1 — SOLVES ONLY, no host syncs: every chunk's work is
-        # enqueued under JAX async dispatch before anything blocks
+        # pass 1 — solves only. (Segmented solves sync on their own
+        # iteration counters internally, so chunks still run in
+        # sequence; the three-pass split buys a SINGLE recovery
+        # decision point over all chunks and keeps objectives computed
+        # strictly on accepted solutions — not cross-chunk overlap.)
         solved_chunks = []
         for ci, (idx_c, real) in enumerate(slices):
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
@@ -520,11 +523,18 @@ class PHBase(SPBase):
             m = float(jnp.max(rec[0].pri_rel))
             if (m <= thr) or ci in no_retry:
                 continue
-            st2, x2, yA2, yB2 = _solver_call(
-                factors, rec[4], rec[5], qp_reset_rho(factors, rec[0]),
-                **kw)
+            if np.isfinite(m):
+                # plateaued far out: keep the iterates, reset the
+                # stepsize trajectory
+                st_r = qp_reset_rho(factors, rec[0])
+            else:
+                # NaN blowup: the iterates themselves are poison — a
+                # rho reset would re-iterate NaNs; restart cold
+                st_r = qp_cold_state(factors, rec[4])
+            st2, x2, yA2, yB2 = _solver_call(factors, rec[4], rec[5],
+                                             st_r, **kw)
             m2 = float(jnp.max(st2.pri_rel))
-            if m2 < m or not np.isfinite(m):
+            if np.isfinite(m2) and (not np.isfinite(m) or m2 < m):
                 rec[:4] = [st2, x2, yA2, yB2]
             if not (m2 <= thr):
                 no_retry.add(ci)

@@ -100,9 +100,10 @@ def test_cross_scenario_process_wheel():
         algo=AlgoConfig(default_rho=1.0, max_iterations=4000,
                         convthresh=-1.0, subproblem_max_iter=2000,
                         subproblem_eps=1e-7),
+        # one spoke: this test pins the cut-window wire layout; the
+        # bound-spoke layouts are covered by the farmer wheel above
         spokes=[SpokeConfig(kind="cross_scenario",
-                            options={"jax_platform": "cpu"}),
-                SpokeConfig(kind="xhatshuffle")],
+                            options={"jax_platform": "cpu"})],
         rel_gap=0.05,
     )
     hub = spin_the_wheel_processes(cfg, join_timeout=180.0)
