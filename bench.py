@@ -299,7 +299,10 @@ def bench_time_to_gap():
 def main():
     # x64 is needed by the f64/mixed engines in metrics 1-2 and the
     # f64 bound spokes in metric 3; per-cylinder dtypes are explicit
+    from mpisppy_tpu.utils.runtime import enable_honest_f32
+
     jax.config.update("jax_enable_x64", True)
+    enable_honest_f32()
     bench_throughput()
     bench_1024()
     bench_time_to_gap()

@@ -521,21 +521,30 @@ class PHBase(SPBase):
         no_retry = self._chunk_no_retry.setdefault(key, set())
         for ci, rec in enumerate(solved_chunks):
             m = float(jnp.max(rec[0].pri_rel))
-            if (m <= thr) or ci in no_retry:
+            is_nan = not np.isfinite(m)
+            # the blacklist stops repeated retries of a genuinely hard
+            # chunk, but NaN iterates MUST always be replaced — storing
+            # them would poison every future warm start
+            if (m <= thr) or (ci in no_retry and not is_nan):
                 continue
-            if np.isfinite(m):
-                # plateaued far out: keep the iterates, reset the
-                # stepsize trajectory
-                st_r = qp_reset_rho(factors, rec[0])
-            else:
+            if is_nan:
                 # NaN blowup: the iterates themselves are poison — a
                 # rho reset would re-iterate NaNs; restart cold
                 st_r = qp_cold_state(factors, rec[4])
+            else:
+                # plateaued far out: keep the iterates, reset the
+                # stepsize trajectory
+                st_r = qp_reset_rho(factors, rec[0])
             st2, x2, yA2, yB2 = _solver_call(factors, rec[4], rec[5],
                                              st_r, **kw)
             m2 = float(jnp.max(st2.pri_rel))
-            if np.isfinite(m2) and (not np.isfinite(m) or m2 < m):
+            if np.isfinite(m2) and (is_nan or m2 < m):
                 rec[:4] = [st2, x2, yA2, yB2]
+            elif is_nan:
+                # both attempts NaN: keep the CLEAN cold state so the
+                # next iteration starts from finite values (zero duals
+                # still certify a valid, if loose, bound)
+                rec[:4] = [st_r, st_r.x, st_r.yA, st_r.yB]
             if not (m2 <= thr):
                 no_retry.add(ci)
         # pass 3 — per-chunk objectives on the accepted solutions

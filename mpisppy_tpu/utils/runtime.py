@@ -10,10 +10,23 @@ from __future__ import annotations
 COMPILE_CACHE_DIR = "/tmp/jax_cache"
 
 
+def enable_honest_f32():
+    """TPU f32 matmuls default to reduced (bf16-pass) precision —
+    enough to stall the f32 ADMM phase near 1e-1 where true f32
+    converges to ~1e-3 (measured: the f32 hub's iter-0 feasibility
+    gate fails on TPU but passes on CPU with identical code). Solver
+    math needs honest f32. ONE policy point: every entry path
+    (setup_jax_runtime, bench.py, __graft_entry__.py) calls this."""
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+
 def setup_jax_runtime(f32: bool = False):
     import jax
 
     if not f32:
         jax.config.update("jax_enable_x64", True)
+    enable_honest_f32()
     jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
