@@ -535,8 +535,19 @@ class PHBase(SPBase):
                 # plateaued far out: keep the iterates, reset the
                 # stepsize trajectory
                 st_r = qp_reset_rho(factors, rec[0])
+            # MIXED configs retry in single-precision-free native mode
+            # (engine dtype is f64 there — 'mixed' requires it): the
+            # mixed retry's f32 bulk phase re-drives the kept iterates
+            # straight back to the plateau being recovered from
+            # (measured on TPU). Budget never shrinks below the
+            # original solve's. Native configs keep their precision
+            # (there is no higher tier to escalate to) and just get
+            # the bigger budget.
+            kw_r = dict(kw, precision="native",
+                        sub_max_iter=max(kw["sub_max_iter"], 1500,
+                                         4 * kw["tail_iter"]))
             st2, x2, yA2, yB2 = _solver_call(factors, rec[4], rec[5],
-                                             st_r, **kw)
+                                             st_r, **kw_r)
             m2 = float(jnp.max(st2.pri_rel))
             if np.isfinite(m2) and (is_nan or m2 < m):
                 rec[:4] = [st2, x2, yA2, yB2]
