@@ -308,9 +308,11 @@ class PHBase(SPBase):
         self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
         self._fixed_mask = jnp.zeros((S, K), bool)   # fixer/xhat support
         self._fixed_vals = jnp.zeros((S, K), t)
-        # chunks whose reset-rho recovery retry didn't help, per mode
-        # key (see _solve_loop_chunked pass 2)
+        # chunks whose reset-rho recovery retry didn't help, and
+        # (chunk, row) scenarios the hospital failed to improve, per
+        # mode key (see _solve_loop_chunked passes 2/2b)
         self._chunk_no_retry = {}
+        self._hospital_no_retry = {}
         # timing splits (ref. spbase.py:261-269 display_timing, a
         # secret-menu option there too): wall seconds per solve_loop
         # call, keyed by mode; off by default (the timing sync would
@@ -373,6 +375,7 @@ class PHBase(SPBase):
             cache.pop(("chunks", ("fixed", True)), None)
         # a new rho deserves fresh recovery chances
         self._chunk_no_retry.clear()
+        self._hospital_no_retry.clear()
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
@@ -543,9 +546,10 @@ class PHBase(SPBase):
             # original solve's. Native configs keep their precision
             # (there is no higher tier to escalate to) and just get
             # the bigger budget.
+            # budget >= the original solve's TOTAL (bulk + tail) work
             kw_r = dict(kw, precision="native",
-                        sub_max_iter=max(kw["sub_max_iter"], 1500,
-                                         4 * kw["tail_iter"]))
+                        sub_max_iter=max(kw["sub_max_iter"]
+                                         + 4 * kw["tail_iter"], 1500))
             st2, x2, yA2, yB2 = _solver_call(factors, rec[4], rec[5],
                                              st_r, **kw_r)
             m2 = float(jnp.max(st2.pri_rel))
@@ -558,6 +562,20 @@ class PHBase(SPBase):
                 rec[:4] = [st_r, st_r.x, st_r.yA, st_r.yB]
             if not (m2 <= thr):
                 no_retry.add(ci)
+        # pass 2b — scenario HOSPITAL: scenarios still far out after the
+        # chunk-level retry get a per-scenario (non-shared) solve. The
+        # shared kernel's Ruiz/cost scaling and rho patterns are
+        # computed against the REFERENCE objective c, while PH solves
+        # the assembled q = c + (W − ρx̄) — for outlier scenarios that
+        # compromise can stall the ADMM at 1e-1-level residuals
+        # regardless of budget (measured: a scenario stuck at 7e-2
+        # through every shared-mode retry converges to 4e-16 in
+        # non-shared mode, where qp_setup scales against ITS OWN q).
+        # Per-scenario (n, n) factorizations are expensive, so this is
+        # capped and only ever runs on the few flagged scenarios.
+        if bool(self.options.get("subproblem_hospital", True)):
+            self._hospitalize(key, slices, solved_chunks, data, thr,
+                              bool(w_on), bool(prox_on))
         # pass 3 — per-chunk objectives on the accepted solutions
         parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
                                  "dual")}
@@ -596,6 +614,84 @@ class PHBase(SPBase):
         self._last_dual_obj = cat["dual"]
         self._ext("post_solve")
         return cat["solved"]
+
+    def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,
+                     prox_on):
+        """Per-scenario rescue solves for chunked-mode stragglers (see
+        the pass-2b comment in _solve_loop_chunked). Selected scenarios
+        are re-assembled and solved NON-shared (own Ruiz/cost scaling
+        against their own assembled q, own adaptive rho, own (n, n)
+        factor) from cold, and their rows scattered back into the
+        accepted chunk results and warm-start states. The selection is
+        padded to ``subproblem_hospital_max`` (default 16) so the
+        non-shared programs compile once."""
+        cap = int(self.options.get("subproblem_hospital_max", 16))
+        # scenarios the hospital already failed to improve: skip them
+        # forever (same recurring-cost bound as pass 2's no_retry — a
+        # cold hospital solve per PH iteration for an incurable row
+        # would be pure waste)
+        failed = self._hospital_no_retry.setdefault(key, set())
+        picks = []                      # (chunk, row, global scenario)
+        for ci, (idx_c, real) in enumerate(slices):
+            pr = np.asarray(solved_chunks[ci][0].pri_rel)[:real]
+            for r in np.flatnonzero(~(pr <= thr)):
+                if (ci, int(r)) not in failed:
+                    picks.append((ci, int(r), int(np.asarray(idx_c)[r]),
+                                  float(pr[r])))
+        if not picks:
+            return
+        picks.sort(key=lambda t: -t[3])     # worst first under the cap
+        picks = picks[:cap]
+        sel = np.array([g for _, _, g, _ in picks])
+        pad = cap - sel.size
+        sel_p = np.concatenate([sel, np.full(pad, sel[0])]) if pad else sel
+        k = sel_p.size
+        n = self.batch.n
+        A_b = jnp.broadcast_to(data.A, (k,) + data.A.shape) \
+            if data.A.ndim == 2 else data.A[sel_p]
+        P_b = jnp.broadcast_to(data.P_diag, (k, n)) \
+            if data.P_diag.ndim == 1 else data.P_diag[sel_p]
+        d_h = QPData(P_b, A_b, data.l[sel_p], data.u[sel_p],
+                     data.lb[sel_p], data.ub[sel_p])
+        ws = None if self._w_scale is None else self._w_scale[sel_p]
+        q_h, d_h = _ph_assemble(d_h, self.c[sel_p], self.W[sel_p],
+                                self.xbar[sel_p], self.rho[sel_p],
+                                self.nonant_idx, self._fixed_mask[sel_p],
+                                self._fixed_vals[sel_p], ws,
+                                w_on=w_on, prox_on=prox_on)
+        fac_h = qp_setup(d_h, q_ref=q_h)
+        st_h = qp_cold_state(fac_h, d_h)
+        st_h, x_h, yA_h, yB_h = _solver_call(
+            fac_h, d_h, q_h, st_h, prox_on=prox_on, precision="native",
+            sub_max_iter=max(3000, self.sub_max_iter),
+            sub_eps=self.sub_eps, sub_eps_hot=self.sub_eps_hot,
+            sub_eps_dua_hot=self.sub_eps_dua_hot,
+            tail_iter=self.sub_tail_iter, stall_rel=self.sub_stall_rel,
+            segment=self.sub_segment, polish_hot=self.sub_polish_hot,
+            polish_chunk=int(self.options.get("subproblem_polish_chunk",
+                                              0)))
+        pr_h = np.asarray(st_h.pri_rel)
+        for j, (ci, r, _, pr_old) in enumerate(picks):
+            if not (pr_h[j] < pr_old):
+                failed.add((ci, r))     # never re-admit; keep the row
+                continue
+            rec = solved_chunks[ci]
+            st = rec[0]
+            # scatter the UNSCALED solution rows + residual rows only.
+            # The hospital's internal iterates live in ITS OWN Ruiz/cost
+            # scaling — transplanting them into the chunk state (a
+            # different scaling) would corrupt the warm start. The
+            # rescued scenario keeps its old chunk-state iterates; if it
+            # stalls again next iteration the hospital re-fires
+            # (bounded: once per iteration, capped batch, failed rows
+            # never re-admitted).
+            rec[0] = st._replace(
+                pri_res=st.pri_res.at[r].set(st_h.pri_res[j]),
+                dua_res=st.dua_res.at[r].set(st_h.dua_res[j]),
+                pri_rel=st.pri_rel.at[r].set(st_h.pri_rel[j]))
+            rec[1] = rec[1].at[r].set(x_h[j])
+            rec[2] = rec[2].at[r].set(yA_h[j])
+            rec[3] = rec[3].at[r].set(yB_h[j])
 
     def _dive_in_chunks(self, factors, d, q, c0, st, imask, **kw):
         """core.mip.dive_integers with scenario microbatching. Dives

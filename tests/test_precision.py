@@ -276,6 +276,38 @@ def test_chunked_rho_pathology_recovery():
     assert pri.max() < 1e-2, f"recovery did not engage: {pri.max():.1e}"
 
 
+def test_chunked_hospital_rescues_flagged_rows():
+    """The scenario hospital re-solves rows flagged far-from-feasible in
+    NON-shared mode (own scaling against the assembled q — the cure for
+    shared-setup stalls) and scatters solutions + residual rows back."""
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 1500,
+            "subproblem_eps": 1e-6, "subproblem_chunk": 3,
+            "subproblem_hospital_max": 4}
+    ph = PHBase(_uc_batch(S=8), opts, dtype=jnp.float64)
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    ph.solve_loop(w_on=True, prox_on=True)
+    factors, data = ph._get_factors(True)
+    slices = ph._chunk_index(3)
+    states = ph._qp_states[("chunks", True)]
+    n = ph.batch.n
+    m = ph.batch.m
+    recs = []
+    for ci, (idx_c, real) in enumerate(slices):
+        st = states[ci]
+        if ci == 1:     # flag one row of chunk 1 as grossly unconverged
+            st = st._replace(pri_rel=st.pri_rel.at[0].set(1.0))
+        recs.append([st, jnp.zeros((3, n)), jnp.zeros((3, m)),
+                     jnp.zeros((3, n)), None, None])
+    ph._hospitalize(True, slices, recs, data, thr=1e-2, w_on=True,
+                    prox_on=True)
+    # the flagged row was cured and its solution scattered back
+    assert float(recs[1][0].pri_rel[0]) < 1e-2
+    assert float(jnp.abs(recs[1][1][0]).max()) > 0.0
+    # unflagged rows untouched
+    assert float(jnp.abs(recs[0][1]).max()) == 0.0
+
+
 def test_chunked_requires_shared_structure():
     from mpisppy_tpu.models import netdes
 
