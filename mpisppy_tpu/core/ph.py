@@ -575,7 +575,7 @@ class PHBase(SPBase):
         # capped and only ever runs on the few flagged scenarios.
         if bool(self.options.get("subproblem_hospital", True)):
             self._hospitalize(key, slices, solved_chunks, data, thr,
-                              bool(w_on), bool(prox_on))
+                              bool(w_on), bool(prox_on), kw)
         # pass 3 — per-chunk objectives on the accepted solutions
         parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
                                  "dual")}
@@ -616,16 +616,20 @@ class PHBase(SPBase):
         return cat["solved"]
 
     def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,
-                     prox_on):
+                     prox_on, kw):
         """Per-scenario rescue solves for chunked-mode stragglers (see
         the pass-2b comment in _solve_loop_chunked). Selected scenarios
         are re-assembled and solved NON-shared (own Ruiz/cost scaling
         against their own assembled q, own adaptive rho, own (n, n)
         factor) from cold, and their rows scattered back into the
         accepted chunk results and warm-start states. The selection is
-        padded to ``subproblem_hospital_max`` (default 16) so the
-        non-shared programs compile once."""
-        cap = int(self.options.get("subproblem_hospital_max", 16))
+        padded to ``subproblem_hospital_max`` so the non-shared
+        programs compile once. The default cap is SMALL (4): the
+        batched (cap, n, n) f64 factorization is a single long device
+        execution, and a cap of 16 tripped the TPU watchdog on the
+        1024-scenario UC run; scenarios beyond the cap stay flagged and
+        are picked up (worst-first) on subsequent iterations."""
+        cap = int(self.options.get("subproblem_hospital_max", 4))
         # scenarios the hospital already failed to improve: skip them
         # forever (same recurring-cost bound as pass 2's no_retry — a
         # cold hospital solve per PH iteration for an incurable row
@@ -635,9 +639,12 @@ class PHBase(SPBase):
         for ci, (idx_c, real) in enumerate(slices):
             pr = np.asarray(solved_chunks[ci][0].pri_rel)[:real]
             for r in np.flatnonzero(~(pr <= thr)):
-                if (ci, int(r)) not in failed:
-                    picks.append((ci, int(r), int(np.asarray(idx_c)[r]),
-                                  float(pr[r])))
+                g = int(np.asarray(idx_c)[r])
+                # keyed by GLOBAL scenario id: chunk-local coordinates
+                # would re-target other scenarios if the chunk size
+                # ever changes mid-run
+                if g not in failed:
+                    picks.append((ci, int(r), g, float(pr[r])))
         if not picks:
             return
         picks.sort(key=lambda t: -t[3])     # worst first under the cap
@@ -661,19 +668,21 @@ class PHBase(SPBase):
                                 w_on=w_on, prox_on=prox_on)
         fac_h = qp_setup(d_h, q_ref=q_h)
         st_h = qp_cold_state(fac_h, d_h)
+        # pass 1's kwargs verbatim (one source of truth for solver
+        # options) with just precision/budget escalated
         st_h, x_h, yA_h, yB_h = _solver_call(
-            fac_h, d_h, q_h, st_h, prox_on=prox_on, precision="native",
-            sub_max_iter=max(3000, self.sub_max_iter),
-            sub_eps=self.sub_eps, sub_eps_hot=self.sub_eps_hot,
-            sub_eps_dua_hot=self.sub_eps_dua_hot,
-            tail_iter=self.sub_tail_iter, stall_rel=self.sub_stall_rel,
-            segment=self.sub_segment, polish_hot=self.sub_polish_hot,
-            polish_chunk=int(self.options.get("subproblem_polish_chunk",
-                                              0)))
+            fac_h, d_h, q_h, st_h,
+            **dict(kw, precision="native",
+                   sub_max_iter=max(3000, kw["sub_max_iter"])))
         pr_h = np.asarray(st_h.pri_rel)
-        for j, (ci, r, _, pr_old) in enumerate(picks):
+        for j, (ci, r, g, pr_old) in enumerate(picks):
+            if not (pr_h[j] <= thr):
+                # one shot per scenario: an improved-but-uncured row
+                # still gets its better solution scattered below, but a
+                # cold hospital solve every future iteration for a row
+                # that never reaches the gate is pure waste
+                failed.add(g)
             if not (pr_h[j] < pr_old):
-                failed.add((ci, r))     # never re-admit; keep the row
                 continue
             rec = solved_chunks[ci]
             st = rec[0]

@@ -299,8 +299,15 @@ def test_chunked_hospital_rescues_flagged_rows():
             st = st._replace(pri_rel=st.pri_rel.at[0].set(1.0))
         recs.append([st, jnp.zeros((3, n)), jnp.zeros((3, m)),
                      jnp.zeros((3, n)), None, None])
+    kw = dict(prox_on=True, precision=ph.sub_precision,
+              sub_max_iter=ph.sub_max_iter, sub_eps=ph.sub_eps,
+              sub_eps_hot=ph.sub_eps_hot,
+              sub_eps_dua_hot=ph.sub_eps_dua_hot,
+              tail_iter=ph.sub_tail_iter, stall_rel=ph.sub_stall_rel,
+              segment=ph.sub_segment, polish_hot=ph.sub_polish_hot,
+              polish_chunk=0, segment_lo=ph.sub_segment_lo)
     ph._hospitalize(True, slices, recs, data, thr=1e-2, w_on=True,
-                    prox_on=True)
+                    prox_on=True, kw=kw)
     # the flagged row was cured and its solution scattered back
     assert float(recs[1][0].pri_rel[0]) < 1e-2
     assert float(jnp.abs(recs[1][1][0]).max()) > 0.0
