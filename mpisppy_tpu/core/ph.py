@@ -675,6 +675,12 @@ class PHBase(SPBase):
             **dict(kw, precision="native",
                    sub_max_iter=max(3000, kw["sub_max_iter"])))
         pr_h = np.asarray(st_h.pri_rel)
+        if self.verbose or self.options.get("hospital_trace", True):
+            worst = " ".join(
+                f"s{g}:{pr_old:.0e}->{pr_h[j]:.0e}"
+                for j, (_, _, g, pr_old) in enumerate(picks))
+            global_toc(f"hospital: treated {len(picks)} scenario(s) "
+                       f"[{worst}]")
         for j, (ci, r, g, pr_old) in enumerate(picks):
             if not (pr_h[j] <= thr):
                 # one shot per scenario: an improved-but-uncured row
