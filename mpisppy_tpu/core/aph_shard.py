@@ -1,0 +1,381 @@
+"""Scenario-sharded APH over the async Synchronizer (multi-process).
+
+The missing half of the reference's APH runtime (ref. mpisppy/opt/aph.py:
+818-921 + mpisppy/utils/listener_util/listener_util.py:277-327): ranks
+hold scenario shards, a listener thread on each rank keeps reducing the
+(x̄, x̄², ȳ) "FirstReduce" and (τ, φ, norms) "SecondReduce" concatenations
+*while* the worker solves, and the worker proceeds once enough ranks have
+fresh data (``async_frac_needed``) — wall-clock overlap of reduction
+communication with subproblem compute, staleness tolerated by design.
+
+Here a "rank" is an OS process owning a contiguous scenario shard
+(ir/batch.py shard_batch — the analog of the reference's contiguous
+rank map, ref. spbase.py:172) with its own engine and device stream; the
+listener exchange rides the native seqlock shm windows through
+utils/synchronizer.Synchronizer (the DCN analog; on a multi-host TPU pod
+each shard process is a host). The in-process APH (core/aph.py) remains
+the single-chip fast path where the reductions are membership matmuls
+inside the jitted step; this module is the multi-host deployment shape.
+
+Reduction layout (per-stage node summands, flattened and concatenated —
+multistage-safe because membership columns are global, see shard_batch):
+
+  First  = [Σp·x | Σp·x² | Σp·y  per (node, slot) | Σp per node
+            | per-shard timestamps]                  (3·Σ N_t k_t + Σ N_t + n)
+  Second = [τ, φ, pusq, pvsq, pwsq, pzsq | per-shard timestamps]   (6 + n)
+
+Timestamps live in per-shard slots (each shard sums in only its own), so
+the reduced vector carries every shard's iteration count — the
+enough-fresh check of the reference's side gig (ref. aph.py:204-324).
+Convergence norms ride the same iteration's SecondReduce computed from
+the PRE-step (W, z): the conv metric is "one notch behind", exactly the
+staleness the reference's worker accepts (ref. listener_util.py:164-182
+keep_up).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import global_toc
+from ..ir.batch import shard_batch
+from ..utils.synchronizer import Synchronizer
+from .aph import APH
+
+
+class APHShard(APH):
+    """One shard's APH engine + worker loop. Construct via ``make_shard``;
+    drive via ``run`` (which owns the Synchronizer listener)."""
+
+    def __init__(self, batch, options, n_shards, my_shard, shm_prefix=None,
+                 windows=None, **kw):
+        opts = dict(options or {})
+        opts["partial_probabilities"] = True
+        super().__init__(batch, opts, **kw)
+        self.n_shards = int(n_shards)
+        self.my_shard = int(my_shard)
+        self.async_frac_needed = float(
+            self.options.get("async_frac_needed", 1.0))
+        self.async_sleep_secs = float(
+            self.options.get("async_sleep_secs", 0.002))
+        # per-stage (N_t, k_t) summand shapes
+        self._stage_shapes = self.stage_shapes(self.batch)
+        nk = sum(N * k for N, k in self._stage_shapes)
+        nden = sum(N for N, _ in self._stage_shapes)
+        self._nk, self._nden = nk, nden
+        lens = self.reduction_lens(self.batch, self.n_shards)
+        self.sync = Synchronizer(
+            lens, self.n_shards, self.my_shard, shm_prefix=shm_prefix,
+            windows=windows,
+            sleep_secs=float(self.options.get("listener_sleep_secs", 0.005)))
+        self._g = {r: np.zeros(l) for r, l in lens.items()}
+        self._l = {r: np.zeros(l) for r, l in lens.items()}
+
+    # ---- wire layout (the ONE definition thread-mode embedders need to
+    # prebuild the shared window table from) ----
+    @staticmethod
+    def stage_shapes(batch):
+        return [(batch.tree.nodes_per_stage[t], sl.stop - sl.start)
+                for t, sl in enumerate(batch.stage_slot_slices)]
+
+    @classmethod
+    def reduction_lens(cls, batch, n_shards):
+        shapes = cls.stage_shapes(batch)
+        nk = sum(N * k for N, k in shapes)
+        nden = sum(N for N, _ in shapes)
+        return {"First": 3 * nk + nden + n_shards,
+                "Second": 6 + n_shards}
+
+    # ---- summand packing ----
+    def _node_summands(self, arr):
+        """Per-stage B_tᵀ(p⊙arr[:, sl]) flattened and concatenated."""
+        p = self.prob[:, None]
+        outs = []
+        for B, sl in zip(self.memberships, self.batch.stage_slot_slices):
+            outs.append(jnp.ravel(B.T @ (p * arr[:, sl])))
+        return jnp.concatenate(outs)
+
+    def _den_summands(self):
+        return jnp.concatenate([B.T @ self.prob for B in self.memberships])
+
+    def _broadcast_nodes(self, flat):
+        """Inverse of _node_summands: (Σ N_t k_t,) node values -> (S, K)."""
+        out, off = [], 0
+        for B, (N, k) in zip(self.memberships, self._stage_shapes):
+            blk = jnp.asarray(flat[off:off + N * k].reshape(N, k), self.dtype)
+            out.append(B @ blk)
+            off += N * k
+        return jnp.concatenate(out, axis=1)
+
+    def _expand_den(self, dens):
+        """(Σ N_t,) per-node masses -> (Σ N_t k_t,) aligned with the
+        flattened per-(node, slot) numerators. A node no published shard
+        passes through has zero mass; its quotient must not NaN-poison
+        the broadcast matmul (0-column · NaN = NaN) — this shard never
+        consumes such nodes (its own summand keeps every node it owns
+        positive), so any placeholder is safe; use 1."""
+        out, off = [], 0
+        for N, k in self._stage_shapes:
+            d = dens[off:off + N]
+            out.append(np.repeat(np.where(d > 0, d, 1.0), k))
+            off += N
+        return np.concatenate(out)
+
+    def _wait_fresh(self, red, it, vec):
+        """Stage my summand (timestamp = it) and spin until the reduced
+        vector shows >= async_frac_needed shards at timestamp >= it (the
+        reference worker's spin for the side gig, ref. aph.py:327-448).
+        The listener keeps folding stragglers in underneath us. The spin
+        polls only the timestamp tail; the full vector is copied once,
+        when fresh. A hard-killed peer never publishes anything — the
+        deadline turns that into an error instead of an infinite spin."""
+        ts = np.zeros(self.n_shards)
+        ts[self.my_shard] = it
+        self._l[red][:] = np.concatenate([vec, ts])
+        need = max(1, int(np.ceil(self.async_frac_needed * self.n_shards)))
+        self.sync.compute_global_data(self._l, self._g, rednames=[red],
+                                      keep_up=True)
+        deadline = time.monotonic() + float(
+            self.options.get("aph_wait_timeout", 600.0))
+        while True:
+            fresh = int((self._g[red][-self.n_shards:] >= it).sum())
+            if fresh >= need or self.sync.global_quitting:
+                self.sync.compute_global_data(self._l, self._g,
+                                              rednames=[red], keep_up=True)
+                return self._g[red][:-self.n_shards]
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"shard {self.my_shard}: {red} never got "
+                    f"{need}/{self.n_shards} fresh shards at iter {it} — "
+                    "a peer process likely died without publishing quit")
+            time.sleep(self.async_sleep_secs)
+            self._g[red][-self.n_shards:] = self.sync.peek_tail(
+                red, self.n_shards)
+
+    # ---- the worker loop (one shard's APH_iterk) ----
+    def _work(self):
+        warm = getattr(self, "_warm_started", False)
+        self.solve_loop(w_on=warm, prox_on=False, update=False)
+        # iter-0 feasibility + trivial bound are genuinely collective:
+        # the reference runs Iter0 synchronously before the listener
+        # starts (ref. aph.py:889); sync_allreduce is that barrier
+        ok, _ = self.iter0_feasible_mask()
+        feas, bound = self.sync.sync_allreduce(
+            np.array([float(np.dot(np.asarray(self.prob), ok)),
+                      self.Ebound()]))
+        if self.options.get("iter0_infeasibility_abort", True) \
+                and abs(feas - 1.0) > 1e-6:
+            raise RuntimeError(f"iter 0: global feasible probability {feas} "
+                               "!= 1 (ref. phbase.py:1415-1427 abort)")
+        self.trivial_bound = self.best_bound = bound
+        # global iter-0 xbar (Update_W reads self.xbar; a shard-local mean
+        # would seed W inconsistently across shards)
+        xn0 = self.nonants_of(self.x)
+        nk, nden = self._nk, self._nden
+        g0 = self.sync.sync_allreduce(np.concatenate([
+            np.asarray(self._node_summands(xn0)),
+            np.asarray(self._den_summands())]))
+        self.xbar = self._broadcast_nodes(g0[:nk] / self._expand_den(g0[nk:]))
+        self.Update_W()
+        if self.use_lag:
+            # lagged (W, z) for dispatched solves (ref. aph.py:188-190)
+            self._W_lag = self.W
+            self._z_lag = self.z
+        global_toc(f"APHShard[{self.my_shard}] iter 0: trivial bound = "
+                   f"{bound:.4f}", self.verbose and self.my_shard == 0)
+
+        nu, gamma = self.nu, self.gamma
+        self.conv = np.inf
+        it = self._iter = 0
+        while it < self.max_iterations and not self.sync.global_quitting:
+            it += 1
+            self._iter = it
+            xn = self.nonants_of(self.x)
+            if it > 1:
+                W_y = self._W_lag if self.use_lag else self.W
+                z_y = self._z_lag if self.use_lag else self.z
+                y_new = W_y + self.rho * (xn - z_y)
+                self.y_aph = jnp.where(
+                    jnp.asarray(self._dispatched)[:, None], y_new, self.y_aph)
+            first = np.asarray(jnp.concatenate([
+                self._node_summands(xn), self._node_summands(xn * xn),
+                self._node_summands(self.y_aph), self._den_summands()]))
+            gfirst = self._wait_fresh("First", it, first)
+            if self.sync.global_quitting:
+                break
+            den = self._expand_den(gfirst[3 * nk:3 * nk + nden])
+            xbar = self._broadcast_nodes(gfirst[:nk] / den)
+            xsqbar = self._broadcast_nodes(gfirst[nk:2 * nk] / den)
+            ybar = self._broadcast_nodes(gfirst[2 * nk:3 * nk] / den)
+
+            u = xn - xbar
+            pusq = float(jnp.dot(self.prob, jnp.sum(u * u, axis=1)))
+            pvsq = float(jnp.dot(self.prob, jnp.sum(ybar * ybar, axis=1)))
+            phi = float(jnp.dot(self.prob, jnp.sum(
+                (self.z - xn) * (self.W - self.y_aph), axis=1)))
+            pwsq = float(jnp.dot(self.prob, jnp.sum(self.W * self.W, axis=1)))
+            pzsq = float(jnp.dot(self.prob, jnp.sum(self.z * self.z, axis=1)))
+            tau_sum = pusq + pvsq / gamma
+            second = np.array([tau_sum, phi, pusq, pvsq, pwsq, pzsq])
+            gsecond = self._wait_fresh("Second", it, second)
+            if self.sync.global_quitting:
+                break
+            gtau, gphi, gpusq, gpvsq, gpwsq, gpzsq = gsecond
+
+            theta = nu * gphi / max(gtau, 1e-30) \
+                if (gtau > 0 and gphi > 0) else 0.0
+            self.W = self.W + theta * u
+            self.z = xbar if it == 1 else self.z + theta * ybar / gamma
+            self.xbar, self.xsqbar, self.ybar = xbar, xsqbar, ybar
+            self.tau, self.phi, self.theta = gtau, gphi, theta
+            # conv from THIS SecondReduce's (W, z) norms — they are the
+            # pre-step norms, i.e. the previous θ-step's result: the
+            # "one notch behind" staleness the reference worker accepts
+            if gpwsq > 0 and gpzsq > 0:
+                self.conv = (np.sqrt(gpusq) / np.sqrt(gpwsq)
+                             + np.sqrt(gpvsq) / np.sqrt(gpzsq))
+            else:
+                self.conv = np.inf
+
+            phis = np.asarray(self.prob * jnp.sum(
+                (self.z - xn) * (self.W - self.y_aph), axis=1))
+            self.phis = phis
+            global_toc(f"APHShard iter {it}: conv={self.conv:.3e} "
+                       f"theta={theta:.3e}",
+                       self.verbose and self.my_shard == 0 and it % 10 == 0)
+            if self.conv < self.convthresh:
+                break
+            frac = 1.0 if it == 1 else self.dispatch_frac
+            mask = self._dispatch_mask(it, frac)
+            self._aph_solve(mask)
+
+        self.sync.quitting = 1
+        # final collective: global expected objective of the CURRENT local
+        # solutions. Evaluated from self.x directly — _last_base_obj also
+        # covers solves whose results were REJECTED for non-dispatched
+        # scenarios (x reverted in _aph_solve), which would price a
+        # solution no scenario actually holds when dispatch_frac < 1
+        try:
+            eobj = self.sync.sync_allreduce(
+                np.array([float(self.Eobjective(
+                    self.scenario_objectives(self.x)))]),
+                abort_on_quit=False, timeout=60.0)[0]
+        except TimeoutError:
+            # a peer died without reaching the wrap-up collective; its
+            # own exception is the root cause — don't mask it with a
+            # stall, report "no global objective" instead
+            eobj = np.nan
+        return self.conv, float(eobj), self.trivial_bound
+
+    def run(self):
+        try:
+            return self.sync.run(self._work)
+        finally:
+            self.sync.close()
+
+
+def shard_range(S, my_shard, n_shards):
+    """The contiguous [lo, hi) scenario range of a shard — the ONE
+    definition both entry points (in-process make_shard, process worker)
+    must agree on (ref. spbase.py:172 _calculate_scenario_ranks)."""
+    if n_shards > S:
+        raise ValueError(
+            f"{n_shards} shards for {S} scenarios would leave empty "
+            "shards (the reference requires scenarios >= ranks too, "
+            "ref. spbase.py:172)")
+    return (S * my_shard) // n_shards, (S * (my_shard + 1)) // n_shards
+
+
+def make_shard(batch, options, n_shards, my_shard, shm_prefix=None,
+               windows=None, **kw):
+    """Build shard ``my_shard`` of ``n_shards`` from the FULL batch: slice
+    the contiguous range, keep global probabilities."""
+    lo, hi = shard_range(batch.S, my_shard, n_shards)
+    return APHShard(shard_batch(batch, lo, hi), options, n_shards, my_shard,
+                    shm_prefix=shm_prefix, windows=windows, **kw)
+
+
+# ---- multi-process driver (the deployment shape: one shard per host
+# process, shm/DCN exchange; ref. aph.py:818 APH_main under mpiexec) ----
+
+def _shard_worker(model, num_scens, creator_kwargs, options, n_shards,
+                  my_shard, prefix, q):
+    import os
+
+    try:
+        os.environ.setdefault("JAX_PLATFORMS",
+                              str((options or {}).get("jax_platform", "cpu")))
+        from ..utils.runtime import setup_jax_runtime
+
+        setup_jax_runtime(f32=bool((options or {}).get("f32", False)))
+        import importlib
+
+        mod = importlib.import_module(f"mpisppy_tpu.models.{model}")
+        from ..ir.batch import build_batch, subtree
+
+        # lower ONLY this shard's scenarios (the reference builds per-rank
+        # locals the same way, ref. spbase.py:242 _create_scenarios) — the
+        # model-lowering step is the expensive part at large S
+        tree = mod.make_tree(num_scens)
+        lo, hi = shard_range(num_scens, my_shard, n_shards)
+        batch = build_batch(mod.scenario_creator, subtree(tree, lo, hi),
+                            creator_kwargs=creator_kwargs)
+        eng = APHShard(batch, options, n_shards, my_shard, shm_prefix=prefix)
+        conv, eobj, triv = eng.run()
+        q.put((my_shard, (conv, eobj, triv, eng._iter)))
+    except Exception as e:           # surface, don't hang the parent —
+        # construction failures (shm open timeout, spbase validation)
+        # must reach the queue too, not just run() failures
+        q.put((my_shard, e))
+        raise
+
+
+def spin_aph_shards(model: str, num_scens: int, options, n_shards: int,
+                    creator_kwargs=None, join_timeout=600.0):
+    """Spawn one OS process per scenario shard and run APHShard in each.
+    Returns shard 0's (conv, Eobjective, trivial_bound, iters). The spawn
+    context is used so children initialize JAX cleanly."""
+    import multiprocessing as mp
+    import os
+    import secrets
+
+    shard_range(num_scens, 0, n_shards)   # fail fast on empty shards
+    ctx = mp.get_context("spawn")
+    prefix = f"/aphs{os.getpid():x}{secrets.token_hex(3)}"
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_shard_worker,
+                         args=(model, num_scens, creator_kwargs,
+                               dict(options or {}), n_shards, i, prefix, q),
+                         daemon=True)
+             for i in range(n_shards)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        import queue as _queue
+
+        for _ in range(n_shards):
+            try:
+                shard, res = q.get(timeout=join_timeout)
+            except _queue.Empty:
+                dead = [i for i, p in enumerate(procs) if not p.is_alive()]
+                raise RuntimeError(
+                    f"APH shards never reported within {join_timeout:.0f}s; "
+                    f"dead shard processes: {dead or 'none (hung)'}")
+            if isinstance(res, Exception):
+                raise RuntimeError(f"APH shard {shard} failed: {res!r}")
+            results[shard] = res
+    finally:
+        for p in procs:
+            p.join(timeout=30.0)
+            if p.is_alive():
+                p.terminate()
+        # terminated/crashed children never reach Synchronizer.close();
+        # reap whatever segments the group left in /dev/shm
+        from ..utils.synchronizer import cleanup_shm
+
+        cleanup_shm(prefix)
+    return results[0]

@@ -822,6 +822,19 @@ class PHBase(SPBase):
                            f"min/mean/max = {lo:.3f}/{mean:.3f}/{hi:.3f} s")
         return out
 
+    def iter0_feasible_mask(self, tol=None):
+        """(ok_per_scenario, tol): the ONE iter-0 feasibility predicate —
+        a scenario passes on EITHER the absolute or the relative primal
+        residual, threshold scaling with the solve tolerance. Shared by
+        assert_feasible_iter0 and the sharded APH's collective gate."""
+        if tol is None:
+            tol = float(self.options.get("iter0_feas_tol",
+                                         max(1e-3, 100 * self.sub_eps)))
+        st = self._qp_states[False]
+        ok = (np.asarray(st.pri_res) <= tol) \
+            | (np.asarray(st.pri_rel) <= tol)
+        return ok, tol
+
     def assert_feasible_iter0(self, tol=None):
         """Abort when any scenario's iter-0 subproblem came out infeasible
         — the analog of the reference quitting when a scenario is
@@ -834,13 +847,7 @@ class PHBase(SPBase):
         sits at ~sub_eps, an infeasible one orders of magnitude above)."""
         if not self.options.get("iter0_infeasibility_abort", True):
             return
-        if tol is None:
-            tol = float(self.options.get("iter0_feas_tol",
-                                         max(1e-3, 100 * self.sub_eps)))
-        st = self._qp_states[False]
-        rel = np.asarray(st.pri_rel)
-        pri = np.asarray(st.pri_res)
-        ok = (pri <= tol) | (rel <= tol)
+        ok, tol = self.iter0_feasible_mask(tol)
         if not np.all(ok):
             bad = np.flatnonzero(~ok)
             names = [self.batch.tree.scen_names[i] for i in bad[:5]]

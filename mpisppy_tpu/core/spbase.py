@@ -102,7 +102,13 @@ class SPBase:
                         "variable-probability mass on a nonant slot")
             self.vprob = jnp.asarray(vp, t)
         elif not variable_probability \
+                and not self.options.get("partial_probabilities") \
                 and abs(float(b.prob.sum()) - 1.0) > 1e-6:
+            # partial_probabilities: this engine holds one SHARD of the
+            # scenario set (core/aph_shard.py) — its locals carry their
+            # GLOBAL probabilities, summing to the shard's mass, exactly
+            # like a reference rank's local scenarios (ref. spbase.py:
+            # 242 _create_scenarios; the sum check there is an Allreduce)
             raise ValueError("scenario probabilities must sum to 1 "
                              "(ref. spbase.py:443 checks)")
         self.c = jnp.asarray(b.c, t)

@@ -108,3 +108,35 @@ def build_batch(scenario_creator, tree: ScenarioTree, creator_kwargs=None,
         nonant_stage=np.asarray(nonant_stage, dtype=np.int32),
         stage_slot_slices=slot_slices,
     )
+
+
+def subtree(t: ScenarioTree, lo: int, hi: int) -> ScenarioTree:
+    """Scenarios [lo, hi) of a tree, keeping GLOBAL probabilities and the
+    full per-stage node index space (membership columns stay global, so
+    cross-shard node summands add)."""
+    return ScenarioTree(
+        t.scen_names[lo:hi], t.node_path[lo:hi],
+        t.nodes_per_stage, t.nonant_names_per_stage,
+        probabilities=t.probabilities[lo:hi])
+
+
+def shard_batch(batch: ScenarioBatch, lo: int, hi: int) -> ScenarioBatch:
+    """Slice scenarios [lo, hi) into a shard batch for a multi-process
+    scenario-sharded engine (core/aph_shard.py) — the analog of a
+    reference rank's local-scenario subset (ref. spbase.py:172
+    _calculate_scenario_ranks contiguous shard map). Probabilities stay
+    GLOBAL (the shard's prob sums to its mass, not 1; pass
+    ``partial_probabilities`` to the engine), and membership matrices
+    keep their full per-stage node columns so cross-shard reductions are
+    plain sums of per-node summands."""
+    from dataclasses import replace
+
+    sub_tree = subtree(batch.tree, lo, hi)
+    sl = slice(lo, hi)
+    return replace(
+        batch, tree=sub_tree,
+        c=batch.c[sl], c0=batch.c0[sl], P_diag=batch.P_diag[sl],
+        A=batch.A[sl], l=batch.l[sl], u=batch.u[sl],
+        lb=batch.lb[sl], ub=batch.ub[sl],
+        c_stage=batch.c_stage[sl], c0_stage=batch.c0_stage[sl],
+        prob=batch.prob[sl])
