@@ -72,6 +72,30 @@ UC_FAST = {
     "subproblem_segment": 2000,
 }
 
+# The solver-grade mixed-precision recipe for metrics 1-2, from the
+# round-3 cost anatomy measured on the tunneled v5e: of the 58 s/chunk
+# the r2-era config spent, ~57 s was the hot-loop active-set POLISH
+# (three rounds of batched emulated-f64 penalty factorizations) and the
+# f32 bulk+f64 tail was ~1 s. Hot solves therefore skip the polish and
+# instead run a tighter bulk (eps_hot 1e-5, stall 1e-4) plus a LONG f64
+# tail (explicit-inverse matmul x-updates at ~1 ms/iter; 3000 iters
+# cost ~3.5 s and carry the warm-started batch to worst ~7e-5,
+# p99 ~2e-5). The polish still runs on prox-off (bound) solves, where
+# dual accuracy pays.
+MIXED_FAST = {
+    "subproblem_precision": "mixed",
+    "subproblem_max_iter": 2000,
+    "subproblem_eps": 1e-5,
+    "subproblem_eps_hot": 1e-5,
+    "subproblem_eps_dua_hot": 1e-3,
+    "subproblem_stall_rel": 1e-4,
+    "subproblem_tail_iter": 3000,
+    "subproblem_segment": 150,
+    "subproblem_segment_lo": 2000,
+    "subproblem_polish_chunk": 16,
+    "subproblem_polish_hot": False,
+}
+
 
 def _build_ph(S, dtype, extra=None, integer=False):
     from mpisppy_tpu.ir.batch import build_batch
@@ -92,18 +116,7 @@ def bench_throughput():
 
     S = 128
     _progress("throughput: building S=128 batch")
-    ph = _build_ph(S, jax.numpy.float64,
-                   extra={"subproblem_polish_chunk": 16,
-                          "subproblem_precision": "mixed",
-                          # measured: a ~300-iteration f64 tail +
-                          # polish reaches the same post-polish quality
-                          # as a 1000-iteration tail (the polish does
-                          # the accuracy work); the tail is the
-                          # dominant per-iteration device cost
-                          "subproblem_tail_iter": 300,
-                          "subproblem_max_iter": 2000,
-                          "subproblem_segment": 150,
-                          "subproblem_segment_lo": 2000})
+    ph = _build_ph(S, jax.numpy.float64, extra=dict(MIXED_FAST))
     _progress("throughput: warmup solve 1 (compiles)")
     ph.solve_loop(w_on=False, prox_on=False)
     ph.W = ph.W_new
@@ -127,8 +140,8 @@ def bench_throughput():
     print(json.dumps({
         "metric": "uc_ph_scenario_subproblem_solves_per_sec",
         "value": round(solves_per_sec, 2),
-        "unit": "solves/s/chip (mixed precision, polished; post-polish "
-                f"max pri_rel {pri_rel:.1e})",
+        "unit": "solves/s/chip (mixed precision f32 bulk + f64 tail; "
+                f"post-solve max pri_rel {pri_rel:.1e})",
         "vs_baseline": round(solves_per_sec / baseline, 2),
     }), flush=True)
 
@@ -145,13 +158,7 @@ def bench_1024():
     S2 = 1024
     _progress("uc1024: building batch")
     ph2 = _build_ph(S2, jax.numpy.float64,
-                    extra={"subproblem_chunk": 128,
-                           "subproblem_precision": "mixed",
-                           "subproblem_max_iter": 2000,
-                           "subproblem_tail_iter": 300,
-                           "subproblem_segment": 150,
-                           "subproblem_segment_lo": 2000,
-                           "subproblem_polish_chunk": 16})
+                    extra=dict(MIXED_FAST, subproblem_chunk=128))
     _progress("uc1024: warmup solve 1 (8 chunks)")
     ph2.solve_loop(w_on=False, prox_on=False)
     ph2.W = ph2.W_new

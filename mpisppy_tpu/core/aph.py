@@ -64,6 +64,31 @@ from .. import global_toc
 from .ph import PHBase
 
 
+def aph_theta_step(u, ybar, W, z, xbar, tau, phi, nu, gamma, iter1: bool):
+    """The θ-step given GLOBAL (τ, φ): θ = νφ/τ when a separating
+    hyperplane was found (τ, φ > 0), W += θu, z += θȳ/γ (z := x̄ at
+    iter 1) (ref. aph.py:451-486 Update_theta_zw). The ONE definition
+    shared by the fused single-chip update below and the sharded
+    multi-process engine (core/aph_shard.py), which feeds it
+    Synchronizer-reduced scalars instead of local reductions."""
+    theta = jnp.where((tau > 0) & (phi > 0),
+                      nu * phi / jnp.maximum(tau, 1e-30), 0.0)
+    W_new = W + theta * u
+    z_new = xbar if iter1 else z + theta * ybar / gamma
+    return W_new, z_new, theta
+
+
+def aph_conv_metric(pusq, pvsq, pwsq, pzsq):
+    """‖u‖_p/‖W‖_p + ‖v‖_p/‖z‖_p from the four reduced square norms
+    (ref. aph.py:497-523 Compute_Convergence); inf until W and z carry
+    mass. Shared by both engines (see aph_theta_step)."""
+    return jnp.where(
+        (pwsq > 0) & (pzsq > 0),
+        jnp.sqrt(pusq) / jnp.sqrt(jnp.maximum(pwsq, 1e-30))
+        + jnp.sqrt(pvsq) / jnp.sqrt(jnp.maximum(pzsq, 1e-30)),
+        jnp.inf)
+
+
 @partial(jax.jit, static_argnames=("iter1",))
 def _aph_update(xn, W, y, z, rho, prob, xbar, ybar, nu, gamma, iter1: bool):
     """The fused projective-hedging update: side-gig quantities + θ-step
@@ -75,16 +100,11 @@ def _aph_update(xn, W, y, z, rho, prob, xbar, ybar, nu, gamma, iter1: bool):
     pvsq = jnp.dot(prob, jnp.sum(ybar * ybar, axis=1))
     tau = pusq + pvsq / gamma
     phi = jnp.dot(prob, jnp.sum((z - xn) * (W - y), axis=1))
-    theta = jnp.where((tau > 0) & (phi > 0), nu * phi / jnp.maximum(tau, 1e-30),
-                      0.0)
-    W_new = W + theta * u
-    z_new = xbar if iter1 else z + theta * ybar / gamma
+    W_new, z_new, theta = aph_theta_step(u, ybar, W, z, xbar, tau, phi,
+                                         nu, gamma, iter1)
     pwsq = jnp.dot(prob, jnp.sum(W_new * W_new, axis=1))
     pzsq = jnp.dot(prob, jnp.sum(z_new * z_new, axis=1))
-    conv = jnp.where((pwsq > 0) & (pzsq > 0),
-                     jnp.sqrt(pusq) / jnp.sqrt(jnp.maximum(pwsq, 1e-30))
-                     + jnp.sqrt(pvsq) / jnp.sqrt(jnp.maximum(pzsq, 1e-30)),
-                     jnp.inf)
+    conv = aph_conv_metric(pusq, pvsq, pwsq, pzsq)
     # post-step per-scenario phis drive dispatch (ref. aph.py:755 phisum)
     phis = prob * jnp.sum((z_new - xn) * (W_new - y), axis=1)
     return W_new, z_new, tau, phi, theta, conv, phis, pusq, pvsq, pwsq, pzsq

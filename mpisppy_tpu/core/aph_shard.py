@@ -43,7 +43,7 @@ import numpy as np
 from .. import global_toc
 from ..ir.batch import shard_batch
 from ..utils.synchronizer import Synchronizer
-from .aph import APH
+from .aph import APH, aph_conv_metric, aph_theta_step
 
 
 class APHShard(APH):
@@ -179,7 +179,10 @@ class APHShard(APH):
             np.asarray(self._node_summands(xn0)),
             np.asarray(self._den_summands())]))
         self.xbar = self._broadcast_nodes(g0[:nk] / self._expand_den(g0[nk:]))
-        self.Update_W()
+        if not warm:
+            # a restored W checkpoint must not be double-updated
+            # (same guard as APH_main, core/aph.py)
+            self.Update_W()
         if self.use_lag:
             # lagged (W, z) for dispatched solves (ref. aph.py:188-190)
             self._W_lag = self.W
@@ -225,20 +228,18 @@ class APHShard(APH):
                 break
             gtau, gphi, gpusq, gpvsq, gpwsq, gpzsq = gsecond
 
-            theta = nu * gphi / max(gtau, 1e-30) \
-                if (gtau > 0 and gphi > 0) else 0.0
-            self.W = self.W + theta * u
-            self.z = xbar if it == 1 else self.z + theta * ybar / gamma
+            # the SAME θ-step as the fused single-chip update, fed the
+            # Synchronizer-reduced globals (see aph.aph_theta_step)
+            self.W, self.z, theta = aph_theta_step(
+                u, ybar, self.W, self.z, xbar, gtau, gphi, nu, gamma,
+                iter1=(it == 1))
+            theta = float(theta)
             self.xbar, self.xsqbar, self.ybar = xbar, xsqbar, ybar
             self.tau, self.phi, self.theta = gtau, gphi, theta
             # conv from THIS SecondReduce's (W, z) norms — they are the
             # pre-step norms, i.e. the previous θ-step's result: the
             # "one notch behind" staleness the reference worker accepts
-            if gpwsq > 0 and gpzsq > 0:
-                self.conv = (np.sqrt(gpusq) / np.sqrt(gpwsq)
-                             + np.sqrt(gpvsq) / np.sqrt(gpzsq))
-            else:
-                self.conv = np.inf
+            self.conv = float(aph_conv_metric(gpusq, gpvsq, gpwsq, gpzsq))
 
             phis = np.asarray(self.prob * jnp.sum(
                 (self.z - xn) * (self.W - self.y_aph), axis=1))

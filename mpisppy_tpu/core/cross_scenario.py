@@ -32,7 +32,8 @@ import numpy as np
 
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import (QPData, qp_setup, qp_solve,
-                             qp_cold_state, qp_dual_objective)
+                             qp_cold_state, qp_dual_objective,
+                             qp_solve_segmented)
 from .ph import PH
 
 
@@ -190,9 +191,12 @@ class CrossScenarioPH(PH):
         if prev is not None:
             st = st._replace(x=prev.x, yA=prev.yA, yB=prev.yB,
                              zA=prev.zA, zB=prev.zB)
-        st, x, yA, yB = qp_solve(factors, d, self._q_ef, st,
-                                 max_iter=self.sub_max_iter,
-                                 eps_abs=self.sub_eps, eps_rel=self.sub_eps)
+        # segmented for host-side rho adaptation on untrusted-f64
+        # backends (see qp_solver._device_f64_linalg_trusted)
+        st, x, yA, yB = qp_solve_segmented(
+            factors, d, self._q_ef, st, max_iter=self.sub_max_iter,
+            segment=min(500, self.sub_max_iter),
+            eps_abs=self.sub_eps, eps_rel=self.sub_eps)
         dual = qp_dual_objective(d, self._q_ef, self._c0_ef, yA, yB,
                                  x_witness=x)
         dual = np.asarray(dual)

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ir.batch import ScenarioBatch
-from ..ops.qp_solver import QPData, qp_setup, qp_solve, qp_cold_state
+from ..ops.qp_solver import (QPData, qp_setup, qp_solve, qp_cold_state,
+                             qp_solve_segmented)
 from .spbase import SPBase
 
 
@@ -108,9 +109,12 @@ class ExtensiveForm(SPBase):
           gap, typically ~1-2%), fully on the accelerator."""
         factors = qp_setup(self.ef_data, q_ref=self.c_ef)
         st = qp_cold_state(factors, self.ef_data)
-        st, x_ef, _, _ = qp_solve(factors, self.ef_data, self.c_ef, st,
-                                  max_iter=max_iter, eps_abs=eps_abs,
-                                  eps_rel=eps_rel)
+        # segmented: watchdog-bounded device executions AND host-side
+        # rho adaptation on backends whose in-jit f64 adaptation is
+        # disabled (see qp_solver._device_f64_linalg_trusted)
+        st, x_ef, _, _ = qp_solve_segmented(
+            factors, self.ef_data, self.c_ef, st, max_iter=max_iter,
+            segment=min(500, max_iter), eps_abs=eps_abs, eps_rel=eps_rel)
         if integer and np.asarray(self.batch.integer).any():
             integer_ef = np.zeros(self.n_ef, bool)
             for s in range(self.batch.S):

@@ -213,7 +213,7 @@ class _ChunkStateView:
     concatenates on demand and caches on the instance."""
 
     _FIELDS = ("x", "yA", "yB", "zA", "zB", "pri_res", "dua_res",
-               "pri_rel")
+               "pri_rel", "dua_rel")
 
     def __init__(self, states, trims, precomputed=None):
         self._states = list(states)
@@ -703,7 +703,8 @@ class PHBase(SPBase):
             rec[0] = st._replace(
                 pri_res=st.pri_res.at[r].set(st_h.pri_res[j]),
                 dua_res=st.dua_res.at[r].set(st_h.dua_res[j]),
-                pri_rel=st.pri_rel.at[r].set(st_h.pri_rel[j]))
+                pri_rel=st.pri_rel.at[r].set(st_h.pri_rel[j]),
+                dua_rel=st.dua_rel.at[r].set(st_h.dua_rel[j]))
             rec[1] = rec[1].at[r].set(x_h[j])
             rec[2] = rec[2].at[r].set(yA_h[j])
             rec[3] = rec[3].at[r].set(yB_h[j])
@@ -735,7 +736,7 @@ class PHBase(SPBase):
                 x=st.x[idx_c], yA=st.yA[idx_c], yB=st.yB[idx_c],
                 zA=st.zA[idx_c], zB=st.zB[idx_c],
                 pri_res=st.pri_res[idx_c], dua_res=st.dua_res[idx_c],
-                pri_rel=st.pri_rel[idx_c])
+                pri_rel=st.pri_rel[idx_c], dua_rel=st.dua_rel[idx_c])
             x, o, f, _ = dive_integers(factors, d_c, q_b[idx_c],
                                        c0_b[idx_c], st_c,
                                        imask_b[idx_c], **kw)

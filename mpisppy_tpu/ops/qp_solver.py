@@ -58,6 +58,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class QPData(NamedTuple):
@@ -99,6 +100,7 @@ class QPState(NamedTuple):
     pri_res: jax.Array    # (S,) unscaled
     dua_res: jax.Array    # (S,) unscaled
     pri_rel: jax.Array    # (S,) pri_res / problem scale (feasibility metric)
+    dua_rel: jax.Array    # (S,) dua_res / dual scale (drives host rho adapt)
 
 
 def _Ax(A, x):
@@ -196,6 +198,60 @@ def _factorize(factors: QPFactors, rho_scale):
                                            lower=True, transpose_a=True)
 
 
+def _device_f64_linalg_trusted():
+    """TPU-family backends execute "f64" cholesky/triangular_solve at
+    ~f32 INTERNAL precision (measured on v5e via axon: batched explicit
+    inverse of the eq-boosted UC KKT comes back with |M@inv - I|max =
+    0.9 at cond 6e3, vs 2e-13 for the same matrix in numpy; even benign
+    random SPD matrices show 1e-6-level f64 residuals). An inverse that
+    wrong turns the ADMM x-update into an expanding map — iterates
+    reach 1e33 within 100 iterations, then NaN (the scenario-hospital's
+    rescue-to-NaN failure mode). CPU/GPU have native f64 linalg."""
+    return jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+
+
+def _needs_host_factor(factors) -> bool:
+    """Non-shared f64 factors on an untrusted-f64-linalg backend must be
+    inverted on the HOST (and in-jit rho refactorization disabled — the
+    axon runtime supports no host callbacks). The SHARED f64 branch
+    keeps the device path: its single unbatched factor measures
+    accurate enough in practice (1024-scenario chunked runs converge to
+    ~1e-5) and sits on the hub's hot path."""
+    return factors.A_s.ndim == 3 and factors.A_s.dtype == jnp.float64 \
+        and not _device_f64_linalg_trusted()
+
+
+def _factorize_host(factors: QPFactors, rho_scale, rows=None):
+    """numpy twin of _factorize's non-shared f64 explicit-inverse branch
+    (see _device_f64_linalg_trusted for why it exists). Eager-only.
+    ``rows``: optional index array — invert only those scenarios' KKTs
+    and return a (len(rows), n, n) block for the caller to scatter."""
+    sel = (lambda a: a if rows is None else a[rows])
+    A_s = sel(np.asarray(factors.A_s))
+    P_s = sel(np.asarray(factors.P_s))
+    g = sel(np.asarray(factors.Eb * factors.D))
+    rho_scale = sel(np.asarray(rho_scale))
+    rA = sel(np.asarray(factors.rho_A)) * rho_scale[:, None]
+    rB = sel(np.asarray(factors.rho_b)) * rho_scale[:, None]
+    M = np.einsum("smi,sm,smj->sij", A_s, rA, A_s)
+    M += np.eye(A_s.shape[-1]) * float(factors.sigma)
+    diag = P_s + g * g * rB
+    idx = np.arange(A_s.shape[-1])
+    M[:, idx, idx] += diag
+    return jnp.asarray(np.linalg.inv(M))
+
+
+def factorize_dispatch(factors: QPFactors, rho_scale):
+    """The ONE eager factorization entry: host-exact inverse on
+    untrusted-f64 backends, device path otherwise. Every eager
+    (re)factorization site must come through here — a site calling
+    _factorize directly silently reintroduces the garbage device
+    inverse (see _device_f64_linalg_trusted)."""
+    if _needs_host_factor(factors):
+        return _factorize_host(factors, rho_scale)
+    return _factorize(factors, rho_scale)
+
+
 def _tri_solve(L, b):
     """Solve M x = b given a true Cholesky factor L; b (S, n). Used by the
     POLISH only (its rho_big penalty systems are too ill-conditioned for
@@ -277,11 +333,11 @@ def qp_reset_rho(factors: QPFactors, state: QPState) -> QPState:
     qp_cold_state and the mixed escalation's phase handoffs use).
     Iterates are kept; only the stepsize/factor reset."""
     ones = jnp.ones_like(state.rho_scale)
-    return state._replace(rho_scale=ones, L=_factorize(factors, ones))
+    return state._replace(rho_scale=ones, L=factorize_dispatch(factors, ones))
 
 
 @jax.jit
-def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
+def _cold_state_jit(factors: QPFactors, data: QPData) -> QPState:
     S, m = data.l.shape
     n = data.lb.shape[-1]
     dt = factors.A_s.dtype
@@ -294,7 +350,30 @@ def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
                    iters=jnp.zeros((), jnp.int32),
                    pri_res=jnp.full((S,), jnp.inf, dt),
                    dua_res=jnp.full((S,), jnp.inf, dt),
-                   pri_rel=jnp.full((S,), jnp.inf, dt))
+                   pri_rel=jnp.full((S,), jnp.inf, dt),
+                   dua_rel=jnp.full((S,), jnp.inf, dt))
+
+
+def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
+    if _needs_host_factor(factors):
+        # host-exact inverse (see _device_f64_linalg_trusted); the rest
+        # of the cold state is zeros — not worth a device program that
+        # would compute (and discard) the garbage batched inverse
+        S, m = data.l.shape
+        n = data.lb.shape[-1]
+        dt = factors.A_s.dtype
+        rho_scale = jnp.ones((S,), dt)
+        return QPState(x=jnp.zeros((S, n), dt), yA=jnp.zeros((S, m), dt),
+                       yB=jnp.zeros((S, n), dt), zA=jnp.zeros((S, m), dt),
+                       zB=jnp.zeros((S, n), dt),
+                       L=factorize_dispatch(factors, rho_scale),
+                       rho_scale=rho_scale,
+                       iters=jnp.zeros((), jnp.int32),
+                       pri_res=jnp.full((S,), jnp.inf, dt),
+                       dua_res=jnp.full((S,), jnp.inf, dt),
+                       pri_rel=jnp.full((S,), jnp.inf, dt),
+                       dua_rel=jnp.full((S,), jnp.inf, dt))
+    return _cold_state_jit(factors, data)
 
 
 def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
@@ -473,7 +552,8 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
     # next q moves it)
     new_state = QPState(x=x, yA=yA, yB=yB, zA=zA, zB=zB, L=L,
                         rho_scale=rho_scale, iters=it,
-                        pri_res=pri, dua_res=dua, pri_rel=pri / pri_sc)
+                        pri_res=pri, dua_res=dua, pri_rel=pri / pri_sc,
+                        dua_rel=dua / dua_sc)
 
     if not polish:
         return new_state, D * x, (E / csx) * yA, (Eb / csx) * yB
@@ -526,6 +606,9 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
     else:
         x_un, yA_un, yB_un, pri, dua, pri_sc = tail(per)
 
+    # dua_rel keeps the pre-polish dual scale (the polish tail returns
+    # no dua_sc); the rel metrics' consumer is the host rho adaptation,
+    # which runs between LOOP segments, before any polish
     new_state = new_state._replace(pri_res=pri, dua_res=dua,
                                    pri_rel=pri / pri_sc)
     return new_state, x_un, yA_un, yB_un
@@ -534,16 +617,29 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
 @partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho",
                                    "polish", "polish_iters", "polish_chunk",
                                    "stall_rel"))
-def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
-             max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
-             alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
-             polish_chunk=0, eps_abs_dua=None, eps_rel_dua=None,
-             stall_rel=0.0):
+def _qp_solve_jit(factors: QPFactors, data: QPData, q, state: QPState,
+                  max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
+                  alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
+                  polish_chunk=0, eps_abs_dua=None, eps_rel_dua=None,
+                  stall_rel=0.0):
     """Jitted single-precision solve — see _solve_impl for the algorithm."""
     return _solve_impl(factors, data, q, state, max_iter, check_every,
                        eps_abs, eps_rel, alpha, adaptive_rho, polish,
                        polish_iters, polish_chunk, eps_abs_dua, eps_rel_dua,
                        stall_rel)
+
+
+def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
+             **kw):
+    """Single-precision solve (see _solve_impl). On backends whose f64
+    device linalg is untrusted (see _device_f64_linalg_trusted),
+    non-shared f64 solves run with IN-JIT rho refactorization disabled —
+    the warm state's host-exact inverse (qp_cold_state / qp_reset_rho /
+    the mixed handoff) stays valid for the whole call, and the axon
+    runtime offers no host callback to refactorize mid-loop."""
+    if kw.get("adaptive_rho", True) and _needs_host_factor(factors):
+        kw["adaptive_rho"] = False
+    return _qp_solve_jit(factors, data, q, state, **kw)
 
 
 def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
@@ -566,6 +662,7 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
     ``max_iter=100, segment=500`` runs up to 500 iterations. Callers
     that need a hard ceiling pass ``segment <= max_iter``."""
     final_polish = kw.pop("polish", True)
+    host_adapt = kw.get("adaptive_rho", True) and _needs_host_factor(factors)
     total = 0
     while total < max_iter:
         # always run FULL segments: max_iter is a static jit arg, so a
@@ -579,11 +676,44 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
         total += ran
         if ran < segment:   # early exit: converged or stalled
             break
+        if host_adapt:
+            # in-jit rho adaptation is disabled on untrusted-f64
+            # backends (qp_solve); the segment boundary is the host's
+            # natural stand-in — same OSQP ratio rule, host-exact
+            # refactorization. Without it, badly scaled scenarios keep
+            # a huge DUAL residual at rho_scale=1 (measured on farmer:
+            # primal 1e-14 but dual objectives thousands of times too
+            # loose), poisoning every certified bound.
+            state = _host_adapt_rho(factors, state)
     # final call: loop skipped (max_iter=0), polish runs
     state, x, yA, yB = qp_solve(factors, data, q, state, max_iter=0,
                                 polish=final_polish, **kw)
     state = state._replace(iters=jnp.asarray(total, jnp.int32))
     return state, x, yA, yB
+
+
+def _host_adapt_rho(factors: QPFactors, state: QPState) -> QPState:
+    """Per-scenario OSQP rho adaptation at a segment boundary, with the
+    refactorization on the HOST (see _device_f64_linalg_trusted): adopt
+    sqrt(pri_rel/dua_rel) when the ideal moved > 5x — the same rule the
+    in-jit non-shared branch applies every 4th residual check."""
+    pr = np.asarray(state.pri_rel)
+    dr = np.asarray(state.dua_rel)
+    ratio = np.sqrt(np.maximum(pr, 1e-30) / np.maximum(dr, 1e-30))
+    old = np.asarray(state.rho_scale)
+    new = np.clip(old * np.clip(ratio, 1e-6, 1e6), 1e-6, 1e6)
+    change = np.maximum(new / old, old / new)
+    mask = np.isfinite(change) & (change > 5.0)
+    if not mask.any():
+        return state
+    rho_np = np.where(mask, new, old)
+    rho = jnp.asarray(rho_np, state.rho_scale.dtype)
+    # invert only the changed scenarios' KKTs and scatter — a full
+    # (S, n, n) host inversion per segment would grow linearly with S
+    rows = np.flatnonzero(mask)
+    L_rows = _factorize_host(factors, rho_np, rows=rows)
+    return state._replace(rho_scale=rho,
+                          L=state.L.at[jnp.asarray(rows)].set(L_rows))
 
 
 def _cast_floats(tree, dt):
@@ -662,7 +792,7 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     dt_hi = state.x.dtype
     rho_hi = st_lo.rho_scale.astype(dt_hi)
     st_hi = _cast_floats(st_lo, dt_hi)._replace(
-        L=_factorize(factors, rho_hi), rho_scale=rho_hi)
+        L=factorize_dispatch(factors, rho_hi), rho_scale=rho_hi)
     # the f64 tail is the real solver: full termination test, rho
     # adaptation on (it refactorizes in f64 when needed), early exit when
     # the warm start was already good (prox-regularized solves)

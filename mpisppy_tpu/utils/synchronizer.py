@@ -285,10 +285,19 @@ class Synchronizer:
         return sleep_min
 
     def _listener_loop(self):
-        while self.global_quitting == 0:
-            sleep_for = self._beat()
-            time.sleep(sleep_for)
-        self._beat()                    # final beat publishes my quit flag
+        # any beat failure (a raising side gig, a torn window) must not
+        # kill the daemon SILENTLY: freeze-without-quit stalls every
+        # peer until their wait timeouts. Publish quit on the way out.
+        try:
+            while self.global_quitting == 0:
+                sleep_for = self._beat()
+                time.sleep(sleep_for)
+        finally:
+            self.quitting = 1
+            try:
+                self._beat()            # final beat publishes my quit flag
+            except Exception:
+                pass
 
     def run(self, work_fct, args=(), kwargs=None):
         """Start the listener daemon, run the worker inline, then quit the

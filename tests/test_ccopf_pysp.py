@@ -29,6 +29,7 @@ def test_ccopf_four_stage_ef_and_ph_agree():
     assert eobj == pytest.approx(ef_obj, rel=5e-3)
 
 
+@pytest.mark.slow
 def test_ccopf_multistage_xbar_structure():
     """Stage-2 nonants agree within each stage-2 node but differ across
     nodes (true multistage nonanticipativity, not an all-scenario mean)."""

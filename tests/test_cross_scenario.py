@@ -49,6 +49,7 @@ def test_augmentation_shapes_and_eta_pinning():
         assert np.abs(aug.A[:, m + r, :]).sum() > 0
 
 
+@pytest.mark.slow
 def test_cross_ph_matches_plain_ph_before_cuts():
     """With zero objective weight and free etas, the augmented engine's PH
     trajectory must match plain PH."""
@@ -79,6 +80,7 @@ def test_cuts_give_certified_ef_outer_bound():
     assert bound >= EF3 * 1.5
 
 
+@pytest.mark.slow
 def test_cut_rollover():
     cph = CrossScenarioPH(_batch(), {"cross_scen_options":
                                      {"max_cut_rounds": 2},

@@ -193,6 +193,7 @@ def test_norm_rho_converger_terminates():
     assert ph.converger.last_norm < 1e3
 
 
+@pytest.mark.slow
 def test_fixer_multistage_fixes_per_scenario_values():
     """On a multistage tree, xbar rows differ per node path; fixing must
     pin each scenario at its OWN row's value, not scenario 0's (the

@@ -38,6 +38,7 @@ def test_farmer_ef_more_scenarios():
     assert -140000 < obj < -90000
 
 
+@pytest.mark.slow
 def test_farmer_scalable_multiplier():
     tree = farmer.make_tree(3)
     batch = build_batch(farmer.scenario_creator, tree,

@@ -56,6 +56,7 @@ def test_lshaped_cut_validity():
     assert np.all(cut_at_probe <= V_true + 1e-4 * np.maximum(1, np.abs(V_true)))
 
 
+@pytest.mark.slow
 def test_small_cut_buffer_matches_unlimited():
     """Slack-aware eviction: a tiny rolling buffer reaches the same
     bound as an effectively unlimited one — binding cuts survive

@@ -229,6 +229,7 @@ def test_chunked_solve_loop_matches_unchunked():
     assert np.asarray(ph_b._qp_states[False].pri_rel).shape == (8,)
 
 
+@pytest.mark.slow
 def test_chunked_dive_candidates_integer_feasible():
     """dive_nonant_candidates under scenario microbatching (with a
     padded uneven final chunk) still produces integral, feasible
@@ -276,6 +277,7 @@ def test_chunked_rho_pathology_recovery():
     assert pri.max() < 1e-2, f"recovery did not engage: {pri.max():.1e}"
 
 
+@pytest.mark.slow
 def test_chunked_hospital_rescues_flagged_rows():
     """The scenario hospital re-solves rows flagged far-from-feasible in
     NON-shared mode (own scaling against the assembled q — the cure for

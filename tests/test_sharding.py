@@ -44,6 +44,7 @@ def test_sharded_ph_matches_single_device():
     assert ph0.trivial_bound == pytest.approx(ph1.trivial_bound, rel=1e-5)
 
 
+@pytest.mark.slow
 def test_padding_for_uneven_scenario_count():
     batch = build_batch(farmer.scenario_creator, farmer.make_tree(6))
     padded, S_orig = pad_batch_for_mesh(batch, 8)

@@ -184,6 +184,7 @@ def test_aphshard_single_shard_matches_serial():
     assert triv <= EF3 + 1.0
 
 
+@pytest.mark.slow
 def test_aphshard_two_shards_converges():
     """2 process-shaped shards agree on the consensus: trivial bound is
     the global one, xbar is identical across shards (it comes from the
