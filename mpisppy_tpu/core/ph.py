@@ -668,12 +668,18 @@ class PHBase(SPBase):
                                 w_on=w_on, prox_on=prox_on)
         fac_h = qp_setup(d_h, q_ref=q_h)
         st_h = qp_cold_state(fac_h, d_h)
-        # pass 1's kwargs verbatim (one source of truth for solver
-        # options) with just precision/budget escalated
+        # pass 1's kwargs with precision/budget escalated and LONG
+        # segments: the batch is tiny (cap rows), so the watchdog
+        # ceiling that sizes the chunked path's segments does not bind,
+        # while the inherited short segment would trigger a host
+        # rho-refactorization every ~150 iterations on untrusted-f64
+        # backends (measured: ~20 host inversions per rescue, tens of
+        # seconds per PH iteration for one sick scenario)
         st_h, x_h, yA_h, yB_h = _solver_call(
             fac_h, d_h, q_h, st_h,
             **dict(kw, precision="native",
-                   sub_max_iter=max(3000, kw["sub_max_iter"])))
+                   sub_max_iter=max(6000, kw["sub_max_iter"]),
+                   segment=1500))
         pr_h = np.asarray(st_h.pri_rel)
         if self.verbose or self.options.get("hospital_trace", True):
             worst = " ".join(

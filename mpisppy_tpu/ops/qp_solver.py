@@ -336,14 +336,14 @@ def qp_reset_rho(factors: QPFactors, state: QPState) -> QPState:
     return state._replace(rho_scale=ones, L=factorize_dispatch(factors, ones))
 
 
-@jax.jit
-def _cold_state_jit(factors: QPFactors, data: QPData) -> QPState:
+def _zero_state(factors: QPFactors, data: QPData, L) -> QPState:
+    """The ONE cold-state literal (zeros + inf residuals + the given
+    factor) — every QPState field addition must land here exactly once."""
     S, m = data.l.shape
     n = data.lb.shape[-1]
     dt = factors.A_s.dtype
     shared = factors.A_s.ndim == 2
     rho_scale = jnp.ones((), dt) if shared else jnp.ones((S,), dt)
-    L = _factorize(factors, rho_scale)
     return QPState(x=jnp.zeros((S, n), dt), yA=jnp.zeros((S, m), dt),
                    yB=jnp.zeros((S, n), dt), zA=jnp.zeros((S, m), dt),
                    zB=jnp.zeros((S, n), dt), L=L, rho_scale=rho_scale,
@@ -354,25 +354,24 @@ def _cold_state_jit(factors: QPFactors, data: QPData) -> QPState:
                    dua_rel=jnp.full((S,), jnp.inf, dt))
 
 
+@jax.jit
+def _cold_state_jit(factors: QPFactors, data: QPData) -> QPState:
+    S = data.l.shape[0]
+    dt = factors.A_s.dtype
+    shared = factors.A_s.ndim == 2
+    rho_scale = jnp.ones((), dt) if shared else jnp.ones((S,), dt)
+    return _zero_state(factors, data, _factorize(factors, rho_scale))
+
+
 def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
     if _needs_host_factor(factors):
-        # host-exact inverse (see _device_f64_linalg_trusted); the rest
-        # of the cold state is zeros — not worth a device program that
-        # would compute (and discard) the garbage batched inverse
-        S, m = data.l.shape
-        n = data.lb.shape[-1]
-        dt = factors.A_s.dtype
-        rho_scale = jnp.ones((S,), dt)
-        return QPState(x=jnp.zeros((S, n), dt), yA=jnp.zeros((S, m), dt),
-                       yB=jnp.zeros((S, n), dt), zA=jnp.zeros((S, m), dt),
-                       zB=jnp.zeros((S, n), dt),
-                       L=factorize_dispatch(factors, rho_scale),
-                       rho_scale=rho_scale,
-                       iters=jnp.zeros((), jnp.int32),
-                       pri_res=jnp.full((S,), jnp.inf, dt),
-                       dua_res=jnp.full((S,), jnp.inf, dt),
-                       pri_rel=jnp.full((S,), jnp.inf, dt),
-                       dua_rel=jnp.full((S,), jnp.inf, dt))
+        # host-exact inverse (see _device_f64_linalg_trusted) — not
+        # worth a device program that would compute (and discard) the
+        # garbage batched inverse
+        S = data.l.shape[0]
+        rho_scale = jnp.ones((S,), factors.A_s.dtype)
+        return _zero_state(factors, data,
+                           factorize_dispatch(factors, rho_scale))
     return _cold_state_jit(factors, data)
 
 

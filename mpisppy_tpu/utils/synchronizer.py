@@ -287,11 +287,16 @@ class Synchronizer:
     def _listener_loop(self):
         # any beat failure (a raising side gig, a torn window) must not
         # kill the daemon SILENTLY: freeze-without-quit stalls every
-        # peer until their wait timeouts. Publish quit on the way out.
+        # peer until their wait timeouts. Publish quit on the way out,
+        # and keep the exception so run() can re-raise it — a crashed
+        # listener must not demote the run to a quiet partial result.
         try:
             while self.global_quitting == 0:
                 sleep_for = self._beat()
                 time.sleep(sleep_for)
+        except BaseException as e:
+            self._listener_error = e
+            raise
         finally:
             self.quitting = 1
             try:
@@ -303,14 +308,20 @@ class Synchronizer:
         """Start the listener daemon, run the worker inline, then quit the
         group (any participant finishing stops every listener — the
         reference's summed quitting reduce, listener_util.py:306)."""
+        self._listener_error = None
         self._listener = threading.Thread(target=self._listener_loop,
                                           name="sp-listener", daemon=True)
         self._listener.start()
         try:
-            return work_fct(*args, **(kwargs or {}))
+            result = work_fct(*args, **(kwargs or {}))
         finally:
             self.quitting = 1
             self._listener.join(timeout=30.0)
+        if self._listener_error is not None:
+            raise RuntimeError("listener thread failed mid-run; the "
+                               "worker's result is built on stale "
+                               "reductions") from self._listener_error
+        return result
 
     @property
     def beats(self):
