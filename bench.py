@@ -162,9 +162,15 @@ def bench_1024():
     _progress("uc1024: warmup solve 1 (8 chunks)")
     ph2.solve_loop(w_on=False, prox_on=False)
     ph2.W = ph2.W_new
-    _progress("uc1024: warmup solve 2")
-    ph2.solve_loop(w_on=True, prox_on=True)
-    ph2.W = ph2.W_new
+    # three hot warmup iterations: the first compiles the hot programs,
+    # the rest settle the warm-start trajectory — per-scenario residuals
+    # keep tightening over the first ~4 PH iterations (measured: worst
+    # 1e-3 -> 9e-5 by iteration 4), so timing earlier would stamp the
+    # metric with a transient quality
+    for k in range(3):
+        _progress(f"uc1024: warmup hot solve {k + 1}/3")
+        ph2.solve_loop(w_on=True, prox_on=True)
+        ph2.W = ph2.W_new
     jax.block_until_ready(ph2.x)
     _progress("uc1024: timing 2 iterations")
     t0 = time.perf_counter()
