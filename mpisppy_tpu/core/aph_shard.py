@@ -145,7 +145,13 @@ class APHShard(APH):
             if fresh >= need or self.sync.global_quitting:
                 self.sync.compute_global_data(self._l, self._g,
                                               rednames=[red], keep_up=True)
-                return self._g[red][:-self.n_shards]
+                # a COPY, not a view into self._g: the buffer is
+                # overwritten in place by the next compute_global_data /
+                # peek_tail, and a caller holding the result across the
+                # next reduce would read silently corrupted data
+                # (ADVICE r3). The per-iteration memcpy is negligible
+                # next to the solves.
+                return self._g[red][:-self.n_shards].copy()
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"shard {self.my_shard}: {red} never got "
@@ -229,7 +235,52 @@ class APHShard(APH):
             gtau, gphi, gpusq, gpvsq, gpwsq, gpzsq = gsecond
 
             # the SAME θ-step as the fused single-chip update, fed the
-            # Synchronizer-reduced globals (see aph.aph_theta_step)
+            # Synchronizer-reduced globals (see aph.aph_theta_step).
+            # CONSISTENCY CAVEAT (deliberate deviation, ADVICE r3): with
+            # async_frac_needed < 1 each shard computes θ from its OWN
+            # staleness-dependent view of (τ, φ), so shards can apply
+            # slightly different θ in the same iteration — whereas the
+            # reference's MPI Allreduce guarantees rank-identical
+            # reduced scalars and one θ per iteration (ref.
+            # listener_util.py:193-199 asynch=False SecondReduce). This
+            # is the price of the wait-free exchange; APH's convergence
+            # theory tolerates bounded staleness in (W, z) exactly as it
+            # tolerates the dispatch lag, and frac=1 (the default)
+            # restores rank-identical scalars because every shard then
+            # folds the same n_shards fresh summands. Deployments that
+            # need strict reference parity at frac < 1 should
+            # periodically barrier via sync_allreduce (aph_sync_every).
+            sync_every = int(self.options.get("aph_sync_every", 0))
+            synced = False
+            if sync_every and it % sync_every == 0:
+                # consistent snapshot: barrier-reduce the FULL
+                # SecondReduce so every shard applies the SAME θ and
+                # sees the SAME conv this iteration (drift cannot
+                # accumulate unboundedly). The collective-call-count
+                # contract of sync_allreduce demands every shard pass
+                # the same barrier sequence — guaranteed because `it`
+                # advances uniformly per shard and, below, the
+                # convthresh exit is restricted to synced iterations
+                # (where conv is rank-identical), so shards cannot
+                # leave the loop at different barrier counts. A peer
+                # quitting mid-barrier (crash or max-iter exit) is a
+                # loop exit for us too, not an error.
+                try:
+                    # same patience as every other wait in this loop —
+                    # the 300 s sync_allreduce default would kill a
+                    # shard waiting on a healthy-but-slow peer several
+                    # iterations behind (hospital-assisted solves run
+                    # tens of seconds per iteration)
+                    gsync = self.sync.sync_allreduce(
+                        second, timeout=float(
+                            self.options.get("aph_wait_timeout", 600.0)))
+                except RuntimeError:
+                    if self.sync.global_quitting:
+                        break
+                    raise
+                (gtau, gphi, gpusq, gpvsq, gpwsq, gpzsq) = (
+                    float(v) for v in gsync[:6])
+                synced = True
             self.W, self.z, theta = aph_theta_step(
                 u, ybar, self.W, self.z, xbar, gtau, gphi, nu, gamma,
                 iter1=(it == 1))
@@ -247,7 +298,15 @@ class APHShard(APH):
             global_toc(f"APHShard iter {it}: conv={self.conv:.3e} "
                        f"theta={theta:.3e}",
                        self.verbose and self.my_shard == 0 and it % 10 == 0)
-            if self.conv < self.convthresh:
+            # with the periodic barrier on, the convthresh exit is only
+            # taken at SYNCED iterations: conv is then rank-identical,
+            # so every shard leaves at the same iteration and the
+            # barrier call counts stay aligned (see the consistency
+            # note above). Without it (pure async), conv is advisory
+            # per shard and the exit is wait-free as before — the only
+            # remaining collective is the wrap-up reduce, which every
+            # shard calls exactly once regardless of exit iteration.
+            if self.conv < self.convthresh and (not sync_every or synced):
                 break
             frac = 1.0 if it == 1 else self.dispatch_frac
             mask = self._dispatch_mask(it, frac)
@@ -307,8 +366,19 @@ def _shard_worker(model, num_scens, creator_kwargs, options, n_shards,
     import os
 
     try:
-        os.environ.setdefault("JAX_PLATFORMS",
-                              str((options or {}).get("jax_platform", "cpu")))
+        # FORCE, not setdefault (matching utils/multiproc.py:81): under
+        # the tunneled-TPU environment JAX_PLATFORMS=axon is exported
+        # globally, and a child inheriting it would fight the parent for
+        # the single-process device tunnel instead of running on cpu.
+        # The env var alone is not enough — jax binds jax_platforms from
+        # the environment at IMPORT time, and the spawn machinery has
+        # already imported this module (and jax with it) before this
+        # worker runs, so the config must be set explicitly too.
+        platform = str((options or {}).get("jax_platform", "cpu"))
+        os.environ["JAX_PLATFORMS"] = platform
+        import jax
+
+        jax.config.update("jax_platforms", platform)
         from ..utils.runtime import setup_jax_runtime
 
         setup_jax_runtime(f32=bool((options or {}).get("f32", False)))

@@ -628,6 +628,9 @@ def _qp_solve_jit(factors: QPFactors, data: QPData, q, state: QPState,
                        stall_rel)
 
 
+_WARNED_FROZEN_RHO = False
+
+
 def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
              **kw):
     """Single-precision solve (see _solve_impl). On backends whose f64
@@ -638,6 +641,27 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
     runtime offers no host callback to refactorize mid-loop."""
     if kw.get("adaptive_rho", True) and _needs_host_factor(factors):
         kw["adaptive_rho"] = False
+        # direct callers (not qp_solve_segmented, which substitutes
+        # _host_adapt_rho at segment boundaries) silently lose rho
+        # adaptation here, and badly scaled scenarios then keep dual
+        # residuals orders of magnitude loose at rho_scale=1 (ADVICE
+        # r3). Tell them once so they can route through
+        # qp_solve_segmented instead.
+        if not kw.pop("_segmented_caller", False):
+            global _WARNED_FROZEN_RHO
+            if not _WARNED_FROZEN_RHO:
+                _WARNED_FROZEN_RHO = True
+                import warnings
+
+                warnings.warn(
+                    "qp_solve: in-jit rho adaptation force-disabled "
+                    "(non-shared f64 factors on a backend with "
+                    "untrusted f64 device linalg). Dual residuals may "
+                    "stay loose at the warm-start rho; use "
+                    "qp_solve_segmented, which adapts rho host-side at "
+                    "segment boundaries.", RuntimeWarning, stacklevel=2)
+    else:
+        kw.pop("_segmented_caller", None)
     return _qp_solve_jit(factors, data, q, state, **kw)
 
 
@@ -670,7 +694,8 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
         # compile path); overshoot is bounded by one segment and the
         # convergence/stall exit stops early anyway
         state, _, _, _ = qp_solve(factors, data, q, state,
-                                  max_iter=segment, polish=False, **kw)
+                                  max_iter=segment, polish=False,
+                                  _segmented_caller=True, **kw)
         ran = int(state.iters)
         total += ran
         if ran < segment:   # early exit: converged or stalled
@@ -686,7 +711,8 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
             state = _host_adapt_rho(factors, state)
     # final call: loop skipped (max_iter=0), polish runs
     state, x, yA, yB = qp_solve(factors, data, q, state, max_iter=0,
-                                polish=final_polish, **kw)
+                                polish=final_polish,
+                                _segmented_caller=True, **kw)
     state = state._replace(iters=jnp.asarray(total, jnp.int32))
     return state, x, yA, yB
 

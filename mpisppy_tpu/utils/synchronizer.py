@@ -158,6 +158,19 @@ class Synchronizer:
         self.quitting = 1
         if self._listener is not None and self._listener.is_alive():
             self._listener.join(timeout=10.0)
+            if self._listener.is_alive():
+                # a hung listener still put/reads the windows; closing
+                # them under it would crash in the native layer instead
+                # of failing gracefully (ADVICE r3). Leak the segments
+                # (cleanup_shm reaps them) and tell the operator.
+                import warnings
+
+                warnings.warn(
+                    "Synchronizer.close(): listener thread still alive "
+                    "after 10 s join — leaving shm windows open "
+                    "(cleanup_shm can reap the segments later)",
+                    RuntimeWarning, stacklevel=2)
+                return
         for row in self._windows.values():
             for p, w in enumerate(row):
                 if hasattr(w, "close"):

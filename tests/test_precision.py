@@ -317,6 +317,33 @@ def test_chunked_hospital_rescues_flagged_rows():
     assert float(jnp.abs(recs[0][1]).max()) == 0.0
 
 
+def test_blacklist_readmission_recovers_row():
+    """A scenario frozen on the hospital blacklist earns a fresh
+    recovery attempt every ``subproblem_blacklist_readmit`` solves of
+    its mode (VERDICT r3: permanent blacklists silently poison x̄/W) —
+    and a row that is in fact curable leaves the blacklist cured."""
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 1200,
+            "subproblem_eps": 1e-6, "subproblem_chunk": 4,
+            "subproblem_blacklist_readmit": 2}
+    ph = PHBase(_uc_batch(S=8), opts, dtype=jnp.float64)
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    ph.solve_loop(w_on=True, prox_on=True)          # mode-True call #1
+    # freeze scenario 5 (chunk 1, row 1) as a standing casualty: both
+    # blacklists claim it, so neither chunk retry nor hospital touches
+    # it on the next solve...
+    key = True
+    ph._chunk_no_retry[key] = {0, 1}
+    ph._hospital_no_retry[key] = {5}
+    # ...until the re-admission boundary (call #2 with readmit=2)
+    # clears both sets and the row's ordinary (already converged)
+    # solve passes the gate without ever re-entering a blacklist
+    ph.solve_loop(w_on=True, prox_on=True)          # mode-True call #2
+    assert ph._chunk_no_retry.get(key) == set()
+    assert 5 not in ph._hospital_no_retry.get(key, set())
+    assert float(np.asarray(ph._qp_states[key].pri_rel).max()) < 1e-2
+
+
 def test_chunked_requires_shared_structure():
     from mpisppy_tpu.models import netdes
 
