@@ -67,7 +67,7 @@ def form_bundles(batch: ScenarioBatch, n_bundles: int) -> ScenarioBatch:
         colmap[j, local_cols] = K + j * nl + np.arange(nl)
 
     prob = np.asarray(b.prob)
-    A_src = np.asarray(b.A)
+    A_src = lambda s: np.asarray(b.A_of(s))
     c_src, c0_src = np.asarray(b.c), np.asarray(b.c0)
     cs_src, c0s_src = np.asarray(b.c_stage), np.asarray(b.c0_stage)
     lb_src, ub_src = np.asarray(b.lb), np.asarray(b.ub)
@@ -97,7 +97,7 @@ def form_bundles(batch: ScenarioBatch, n_bundles: int) -> ScenarioBatch:
         for j, s in enumerate(members):
             w = prob[s] / bprob[bi]     # conditional member weight
             rows = slice(j * m, (j + 1) * m)
-            A[bi, rows][:, colmap[j]] = A_src[s]
+            A[bi, rows][:, colmap[j]] = A_src(s)
             l[bi, rows] = l_src[s]
             u[bi, rows] = u_src[s]
             np.add.at(c[bi], colmap[j], w * c_src[s])

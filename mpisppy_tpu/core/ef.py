@@ -69,7 +69,7 @@ class ExtensiveForm(SPBase):
         A_ef = np.zeros((S * m, self.n_ef))
         for s in range(S):
             # colmap[s] is injective, so this is a pure column scatter
-            A_ef[s * m:(s + 1) * m][:, colmap[s]] = np.asarray(b.A[s])
+            A_ef[s * m:(s + 1) * m][:, colmap[s]] = np.asarray(b.A_of(s))
         l_ef = np.asarray(b.l).reshape(-1)
         u_ef = np.asarray(b.u).reshape(-1)
 

@@ -77,7 +77,7 @@ class LShapedMethod(PHBase):
         # first-stage rows: support entirely inside the nonant columns,
         # taken from scenario 0 like the reference takes scenario #1
         # (ref. lshaped.py:143 _create_master_no_scenarios)
-        A0 = np.asarray(b.A[0])
+        A0 = np.asarray(b.A_of(0))
         nonant_set = np.zeros(n, bool)
         nonant_set[idx] = True
         local_cols = np.flatnonzero(~nonant_set)
@@ -107,7 +107,7 @@ class LShapedMethod(PHBase):
         for mi, s in enumerate(ms):
             rows = slice(m1 + Se * C + mi * m, m1 + Se * C + (mi + 1) * m)
             cols = slice(K + Se + mi * nloc, K + Se + (mi + 1) * nloc)
-            A_s = np.asarray(b.A[s])
+            A_s = np.asarray(b.A_of(s))
             A[rows, :K] = A_s[:, idx]
             A[rows, cols] = A_s[:, local_cols]
             l[rows] = np.asarray(b.l[s])

@@ -147,7 +147,11 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
     e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
         else sub_eps
     do_polish = polish_hot or not prox_on
-    if precision == "mixed":
+    if precision in ("mixed", "df32"):
+        # df32 differs from mixed only in the data representation (the
+        # engine's A is a SplitMatrix, see spbase) — the driver is the
+        # same f32-bulk + accurate-tail escalation, with the tail's
+        # matvecs/factor in split-f32 instead of emulated f64
         # f32 bulk + f64 tail (see qp_solve_mixed): data/state stay f64
         return qp_solve_mixed(factors, d, q, qp_state,
                               max_iter=sub_max_iter, tail_iter=tail_iter,
@@ -265,10 +269,11 @@ class PHBase(SPBase):
         _sl = opts.get("subproblem_segment_lo", None)
         self.sub_segment_lo = None if _sl is None else int(_sl)
         self.sub_polish_hot = bool(opts.get("subproblem_polish_hot", True))
-        if self.sub_precision == "mixed" and self.dtype != jnp.float64:
-            raise ValueError("subproblem_precision='mixed' needs dtype="
-                             "float64 (enable jax_enable_x64); got "
-                             f"{self.dtype}")
+        if self.sub_precision in ("mixed", "df32") \
+                and self.dtype != jnp.float64:
+            raise ValueError(f"subproblem_precision={self.sub_precision!r}"
+                             " needs dtype=float64 (enable "
+                             f"jax_enable_x64); got {self.dtype}")
         self.rho_setter = rho_setter
         self.extensions = extensions
         self.converger_cls = converger
@@ -341,6 +346,13 @@ class PHBase(SPBase):
                     jnp.asarray(rho_np[0], self.dtype))
                 return d._replace(P_diag=P)
             # per-scenario rho: fall back to the batched representation
+            from ..ops.qp_solver import SplitMatrix
+            if isinstance(d.A, SplitMatrix):
+                raise ValueError(
+                    "per-scenario rho needs the batched (S, m, n) "
+                    "representation, which the df32 SplitMatrix cannot "
+                    "broadcast to — use a uniform rho with "
+                    "subproblem_precision='df32'")
             S = self.batch.S
             P = jnp.broadcast_to(d.P_diag, (S,) + d.P_diag.shape) \
                 .at[:, self.nonant_idx].add(self.rho)
@@ -360,6 +372,7 @@ class PHBase(SPBase):
         so one factorization serves every candidate x̂."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._factors:
+            from ..ops.qp_solver import SplitMatrix, qp_setup_like
             d = self._data_with_prox(prox_on)
             d_setup = d
             if fixed:
@@ -369,7 +382,41 @@ class PHBase(SPBase):
                 idx = self.nonant_idx
                 d_setup = d._replace(lb=d.lb.at[:, idx].set(0.0),
                                      ub=d.ub.at[:, idx].set(0.0))
-            self._factors[key] = (qp_setup(d_setup, q_ref=self.c), d)
+            is_split = isinstance(self.qp_data.A, SplitMatrix)
+            base = next((f for f, _ in self._factors.values()), None)
+            if base is not None and isinstance(base.A_s, SplitMatrix):
+                # df32: every mode shares ONE equilibration + scaled
+                # split matrix — a per-mode qp_setup would put another
+                # (m, n) split pair in HBM per mode (gigabytes at the
+                # scale this representation exists for)
+                fac = qp_setup_like(base, d_setup)
+            elif is_split and self.mesh is None:
+                # cross-ENGINE sharing through the batch device cache
+                # (single-device engines only — cached arrays carry
+                # placement): every cylinder of an in-process wheel
+                # holds the same batch, and one scaled split matrix
+                # must serve them all. Engines run in concurrent
+                # threads, so the build is serialized under the
+                # cache's lock (see spbase) — otherwise each thread
+                # would put its own multi-GB split in HBM before any
+                # cache write landed.
+                import threading
+                cache = getattr(self.batch, "_dev_cache", None)
+                if cache is None:
+                    cache = self.batch._dev_cache = {}
+                lock = cache.setdefault("_lock", threading.Lock())
+                with lock:
+                    bkey = ("factors_base", str(self.dtype))
+                    base = cache.get(bkey)
+                    if base is not None:
+                        fac = qp_setup_like(base, d_setup)
+                    else:
+                        fac = qp_setup(d_setup, q_ref=self.c)
+                        cache[bkey] = fac
+            else:
+                # mesh df32 engines (or non-split) build their own
+                fac = qp_setup(d_setup, q_ref=self.c)
+            self._factors[key] = (fac, d)
         return self._factors[key]
 
     def invalidate_factors(self):
@@ -602,7 +649,14 @@ class PHBase(SPBase):
         # non-shared mode, where qp_setup scales against ITS OWN q).
         # Per-scenario (n, n) factorizations are expensive, so this is
         # capped and only ever runs on the few flagged scenarios.
-        if bool(self.options.get("subproblem_hospital", True)):
+        from ..ops.qp_solver import SplitMatrix
+        if bool(self.options.get("subproblem_hospital", True)) \
+                and not isinstance(data.A, SplitMatrix):
+            # the hospital builds per-scenario (cap, m, n) batched
+            # factors — structurally impossible at the scale df32
+            # exists for (one (n, n) f64 host inversion there costs
+            # minutes); stragglers rely on chunk retries + blacklist
+            # re-admission instead
             self._hospitalize(key, slices, solved_chunks, data, thr,
                               bool(w_on), bool(prox_on), kw)
         # standing-casualty observability (VERDICT r3 #6): rows STILL
@@ -966,7 +1020,7 @@ class PHBase(SPBase):
         mask = self.nonant_integer_mask
         return np.where(mask, np.round(vals), vals)
 
-    def calculate_incumbent(self, xhat_vals, feas_tol=None):
+    def calculate_incumbent(self, xhat_vals, feas_tol=None, pin_mask=None):
         """Fix nonants at `xhat_vals` ((K,) or (S,K)), solve with W/prox off,
         and return the expected objective, or None if any scenario's
         subproblem is infeasible at that x̂ (ref. xhat_tryer.py:159-182
@@ -975,6 +1029,16 @@ class PHBase(SPBase):
         absolute or relative to problem scale (the solver terminates on the
         relative criterion, so large-coefficient models can't hit a tight
         absolute residual).
+
+        ``pin_mask`` ((K,) bool, default all): pin only those nonant
+        slots. For models whose nonant blocks contain DERIVED variables
+        (UC: the startup indicators are determined by the commitment
+        through st_t >= u_t − u_{t−1} and positive startup costs), the
+        derived slots are left to the solve — they come out identical
+        across scenarios (a deterministic function of the pinned
+        block), so the incumbent stays nonanticipative and the bound
+        valid, while pinning them independently would fight the
+        coupling rows.
         """
         if feas_tol is None:
             feas_tol = float(self.options.get("xhat_feas_tol", 1e-4))
@@ -987,7 +1051,7 @@ class PHBase(SPBase):
                  getattr(self, "_last_base_obj", None),
                  getattr(self, "_last_solved_obj", None),
                  getattr(self, "_last_dual_obj", None))
-        self.fix_nonants(self.round_nonants(xhat_vals))
+        self.fix_nonants(self.round_nonants(xhat_vals), mask=pin_mask)
         try:
             # integer columns OUTSIDE the nonant set (second-stage
             # integers) need a dive to integral values — the reference
@@ -1031,7 +1095,8 @@ class PHBase(SPBase):
              self._last_base_obj, self._last_solved_obj,
              self._last_dual_obj) = saved
 
-    def dive_nonant_candidates(self, X=None, feas_tol=1e-3, max_iter=None):
+    def dive_nonant_candidates(self, X=None, feas_tol=None, max_iter=None,
+                               dive_slots=None):
         """Per-scenario INTEGER-FEASIBLE nonant schedules via the batched
         dive — incumbent candidates for the x̂ spokes on integer models.
 
@@ -1046,11 +1111,28 @@ class PHBase(SPBase):
         toward ``X`` (the hub's consensus) when given — strongly convex
         inner solves, candidates that track the hub's trajectory.
 
+        ``dive_slots`` ((K,) bool, default all): restrict the dive to
+        those nonant slots' integer columns — the candidate side of
+        calculate_incumbent's ``pin_mask`` (DERIVED nonants like UC's
+        startup indicators must not be dived independently of the
+        commitments that determine them; diving both fights the
+        coupling rows and returns nothing feasible).
+
         Returns (cands (S, K), feasible (S,) bool)."""
+        if feas_tol is None:
+            # the df32 kernel's residual floor under heavily pinned
+            # bounds sits near 1e-3 — a gate AT the floor rejects every
+            # candidate; consumers that need certainty re-evaluate the
+            # winners exactly (xhat_exact_eval / host oracle)
+            feas_tol = 5e-3 if self.sub_precision == "df32" else 1e-3
         n = self.batch.n
         idx_np = np.asarray(self.batch.nonant_idx)
         imask = np.zeros(n, bool)
         imask[idx_np] = np.asarray(self.batch.integer)[idx_np]
+        if dive_slots is not None:
+            keep = np.zeros(n, bool)
+            keep[idx_np[np.asarray(dive_slots, bool)]] = True
+            imask &= keep
         if not imask.any():
             xn = self._hub_nonants() if X is None else jnp.asarray(X)
             return np.asarray(xn), np.ones(self.batch.S, bool)

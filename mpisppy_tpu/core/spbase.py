@@ -24,6 +24,72 @@ import numpy as np
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import QPData
 
+# Above this size, host->device shipping goes structure-aware: the
+# tunneled-TPU links this framework targets move host->device data at
+# ~1 MB/s (measured), so a reference-scale UC batch shipped dense
+# (2.7 GB constraint matrix + ~0.7 GB of scenario vectors at S=1024)
+# would spend the better part of an hour in transfers. The constraint
+# matrix is ~0.03% dense and the scenario vectors are one template
+# plus a handful of patched columns per scenario — megabytes of real
+# information — so the device-side arrays are BUILT by scatter instead.
+_SHIP_DENSE_LIMIT = 32 * 1024 * 1024
+
+
+def ship_stacked(a_np, t):
+    """(S, ...) stacked host array -> device array of dtype ``t``,
+    shipping only the scenario-0 template plus the columns where any
+    scenario differs when that is substantially smaller than the dense
+    array (true for structure-shared models, where randomness touches
+    a few rhs/bound entries per scenario)."""
+    a = np.asarray(a_np)
+    if a.ndim < 2 or a.nbytes < _SHIP_DENSE_LIMIT:
+        return jnp.asarray(a, t)
+    S = a.shape[0]
+    flat = a.reshape(S, -1)
+    tmpl = flat[0]
+    diff = np.flatnonzero((flat != tmpl[None, :]).any(axis=0))
+    itemsize = np.dtype(t).itemsize
+    patch_bytes = (tmpl.size + S * diff.size) * itemsize
+    if patch_bytes > a.nbytes // 8:
+        return jnp.asarray(a, t)
+    base = jnp.broadcast_to(jnp.asarray(tmpl, t), flat.shape)
+    if diff.size:
+        base = base.at[:, jnp.asarray(diff)].set(
+            jnp.asarray(flat[:, diff], t))
+    return base.reshape(a.shape)
+
+
+def ship_shared_matrix(A2d, t, split=False):
+    """Shared (m, n) constraint matrix -> device dense array (or the
+    df32 SplitMatrix pair), built by index scatter from the host's
+    sparse representation when dense shipping would dominate."""
+    from ..ops.qp_solver import SplitMatrix, split_f32_np
+
+    A = np.asarray(A2d)
+    n_parts = 2 if split else 1
+    part_dt = jnp.float32 if split else t
+    dense_bytes = A.size * np.dtype(part_dt).itemsize * n_parts
+    rows, cols = np.nonzero(A)
+    sparse_bytes = rows.size * (8 + 4 * n_parts)
+    use_scatter = dense_bytes >= _SHIP_DENSE_LIMIT \
+        and sparse_bytes < dense_bytes // 8
+
+    if split:
+        hi_np, lo_np = split_f32_np(A)
+        if not use_scatter:
+            return SplitMatrix(jnp.asarray(hi_np), jnp.asarray(lo_np))
+        r = jnp.asarray(rows.astype(np.int32))
+        c = jnp.asarray(cols.astype(np.int32))
+        z = jnp.zeros(A.shape, jnp.float32)
+        return SplitMatrix(z.at[r, c].set(jnp.asarray(hi_np[rows, cols])),
+                           z.at[r, c].set(jnp.asarray(lo_np[rows, cols])))
+    if not use_scatter:
+        return jnp.asarray(A, t)
+    r = jnp.asarray(rows.astype(np.int32))
+    c = jnp.asarray(cols.astype(np.int32))
+    return jnp.zeros(A.shape, t).at[r, c].set(
+        jnp.asarray(A[rows, cols], t))
+
 
 def compute_xbar(memberships, slot_slices, weights, xn):
     """Nonanticipative mean per tree node, broadcast back to scenarios.
@@ -111,9 +177,9 @@ class SPBase:
             # 242 _create_scenarios; the sum check there is an Allreduce)
             raise ValueError("scenario probabilities must sum to 1 "
                              "(ref. spbase.py:443 checks)")
-        self.c = jnp.asarray(b.c, t)
+        self.c = ship_stacked(b.c, t)
         self.c0 = jnp.asarray(b.c0, t)
-        self.c_stage = jnp.asarray(b.c_stage, t)
+        self.c_stage = ship_stacked(b.c_stage, t)
         self.c0_stage = jnp.asarray(b.c0_stage, t)
         self.nonant_idx = jnp.asarray(b.nonant_idx)
         self.P_diag = jnp.asarray(b.P_diag, t)
@@ -125,17 +191,75 @@ class SPBase:
         # the representation that reaches the reference's 1000-scenario
         # north star (ref. paperruns/larger_uc/1000scenarios_wind).
         A_np, P_np = np.asarray(b.A), np.asarray(b.P_diag)
-        self.shared_structure = bool(
-            b.S > 1 and (A_np == A_np[0]).all() and (P_np == P_np[0]).all())
+        if A_np.ndim == 2:
+            # batch already carries ONE shared matrix (ir/batch.py
+            # compaction or the vector_patch fast path); the kernel's
+            # shared mode additionally needs a shared quadratic
+            self.shared_structure = bool((P_np == P_np[0]).all())
+            if not self.shared_structure:
+                raise ValueError(
+                    "batch has a shared A but per-scenario P_diag — "
+                    "the QP kernel's shared mode needs both (broadcast "
+                    "A to (S, m, n) upstream for per-scenario quads)")
+        else:
+            self.shared_structure = bool(
+                b.S > 1 and (A_np == A_np[0]).all()
+                and (P_np == P_np[0]).all())
         if self.shared_structure:
-            A_dev = jnp.asarray(A_np[0], t)
+            A2d = A_np if A_np.ndim == 2 else A_np[0]
+            split = str(self.options.get("subproblem_precision",
+                                         "")) == "df32"
+            if split and t != jnp.float64:
+                # big-instance df32: A lives on device ONLY as the
+                # two-term f32 split (see ops/qp_solver.SplitMatrix) —
+                # no f64 copy in HBM, no emulated-f64 matmul ever
+                raise ValueError("subproblem_precision='df32' needs "
+                                 "dtype=float64 (enable x64)")
+            # per-batch device cache: every in-process cylinder of a
+            # wheel builds an engine over the SAME host batch — without
+            # sharing, each would put its own copy of the (m, n)
+            # matrix (and, via ph._get_factors, its own scaled split)
+            # in HBM, which at reference-UC scale OOMs the chip at
+            # wheel width 3. jax arrays are immutable, so sharing is
+            # safe; mesh runs bypass the cache (placement differs).
+            # mesh runs must neither create NOR read the cache: cached
+            # arrays carry single-device placement from a prior
+            # non-mesh engine over the same batch object
+            cache = getattr(b, "_dev_cache", None) if mesh is None \
+                else None
+            if cache is None and mesh is None:
+                cache = b._dev_cache = {}
+            if cache is not None:
+                # cylinder threads hit the cache concurrently (engines
+                # build factors lazily on their first solve); without a
+                # lock each would build its own multi-GB device copy
+                # before any setdefault landed — the OOM the cache
+                # exists to prevent. dict.setdefault is atomic, so one
+                # lock object wins and all threads share it.
+                import threading
+                lock = cache.setdefault("_lock", threading.Lock())
+
+            def cached(key, fn):
+                if cache is None:
+                    return fn()
+                with lock:
+                    if key not in cache:
+                        cache[key] = fn()
+                    return cache[key]
+
+            A_dev = cached(("A", str(t), split),
+                           lambda: ship_shared_matrix(A2d, t, split=split))
             P_dev = jnp.asarray(P_np[0], t)
         else:
+            cached = lambda key, fn: fn()
             A_dev = jnp.asarray(A_np, t)
             P_dev = self.P_diag
         self.qp_data: QPData = QPData(
-            P_dev, A_dev, jnp.asarray(b.l, t), jnp.asarray(b.u, t),
-            jnp.asarray(b.lb, t), jnp.asarray(b.ub, t))
+            P_dev, A_dev,
+            cached(("l", str(t)), lambda: ship_stacked(b.l, t)),
+            cached(("u", str(t)), lambda: ship_stacked(b.u, t)),
+            cached(("lb", str(t)), lambda: ship_stacked(b.lb, t)),
+            cached(("ub", str(t)), lambda: ship_stacked(b.ub, t)))
         # per-stage membership matrices for nonant reductions
         self.memberships = [jnp.asarray(b.tree.membership(s + 1), t)
                             for s in range(b.tree.num_stages - 1)]

@@ -42,6 +42,25 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             self.options.get("xhat_min_interval", 0.0))
         self._last_try = -float("inf")
         self._oracle_pool = None
+        # ``xhat_pin_vars``: names of the nonant vars a candidate PINS;
+        # the rest are DERIVED slots left to the evaluation solve (UC:
+        # pin the commitments u, derive the startups st — pinning both
+        # independently fights the min-up/down coupling rows and no
+        # dived candidate is ever feasible). None = pin everything.
+        pin_names = self.options.get("xhat_pin_vars")
+        self._pin_mask = None
+        if pin_names is not None:
+            b = self.opt.batch
+            idx = np.asarray(b.nonant_idx)
+            col_in = np.zeros(b.n, bool)
+            for name in pin_names:
+                sl = b.template.var_slices[name]
+                col_in[sl] = True
+            self._pin_mask = col_in[idx]          # (K,) bool
+        # exact-evaluator integrality: None = auto (MILP iff unpinned
+        # integer columns exist); models whose unpinned slots are
+        # integral at the LP optimum by structure set False (UC)
+        self._eval_milp = self.options.get("xhat_eval_milp")
 
     def candidates(self, X):
         """Yield (K,) or (S,K) candidate nonant blocks from hub nonants X."""
@@ -61,10 +80,52 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             if key == getattr(self, "_last_key", None):
                 continue
             self._last_key = key
-            obj = self.opt.calculate_incumbent(xhat)
+            obj = self.opt.calculate_incumbent(xhat,
+                                               pin_mask=self._pin_mask)
             if obj is not None and (self.bound is None or obj < self.bound):
+                # ``xhat_exact_eval``: re-evaluate the improving
+                # candidate on the HOST oracle (fixed nonants, exact
+                # dispatch). At df32 scale the device evaluator's
+                # tolerance-level feasibility can mis-state
+                # penalty-dominated objectives by (violation × VOLL) —
+                # the published INNER bound must be a true upper bound,
+                # so the host value replaces the device estimate (and a
+                # host-infeasible candidate publishes nothing).
+                if self.options.get("xhat_exact_eval", False):
+                    status, exact = self._exact_eval(xhat)
+                    if status == "ok":
+                        if exact is None or (self.bound is not None
+                                             and exact >= self.bound):
+                            continue       # host-infeasible or no gain
+                        obj = exact
+                    # "unavailable": publish the device value as before
                 self.best_xhat = self.opt.round_nonants(xhat)
                 self.update_bound(obj)
+
+    def _exact_eval(self, xhat):
+        """("ok", value-or-None) from the host oracle, or
+        ("unavailable", None) when the oracle cannot run here."""
+        if self._oracle_pool is False:
+            return "unavailable", None
+        try:
+            if self._oracle_pool is None:
+                from ..utils.host_oracle import OraclePool
+                self._oracle_pool = OraclePool(
+                    self.opt.batch,
+                    n_workers=self.options.get("xhat_oracle_workers"))
+            return "ok", self._oracle_pool.incumbent_value(
+                self.opt.round_nonants(xhat), self.opt.batch.prob,
+                milp=self._eval_milp, pin_mask=self._pin_mask,
+                time_limit=float(self.options.get(
+                    "xhat_oracle_time_limit", 60.0)),
+                kill_check=self.killed)
+        except Exception as e:
+            from .. import global_toc
+            global_toc(f"{type(self).__name__}: exact incumbent eval "
+                       f"unavailable ({e!r}); keeping device values")
+            if self._oracle_pool is None:
+                self._oracle_pool = False
+            return "unavailable", None
 
     def _prepare_candidates(self, X):
         """On integer-nonant models, replace the hub's fractional nonant
@@ -98,7 +159,8 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             # or a failed solve) get dived schedules — a subclass like
             # the shuffle looper draws candidates from EVERY row, and a
             # raw fractional row would waste its evaluation pass
-            cands, feasible = self.opt.dive_nonant_candidates(X)
+            cands, feasible = self.opt.dive_nonant_candidates(
+                X, dive_slots=self._pin_mask)
             take = ~filled & np.asarray(feasible)
             out[take] = np.asarray(cands)[take]
         return out

@@ -43,6 +43,10 @@ class StandardForm:
     c0_stage: np.ndarray   # (num_stages,)
     var_names: list = field(default_factory=list)
     var_slices: dict = field(default_factory=dict)
+    # row range of each NAMED constraint block in A/l/u — the address
+    # space of the vector-patch fast path (ir/batch.py
+    # build_batch(vector_patch=...)); unnamed constraints get "con{i}"
+    con_slices: dict = field(default_factory=dict)
     sense: str = "min"     # lowered form is always minimization; this records
                            # the user sense so objective values can be reported
                            # in the user's convention
@@ -75,13 +79,23 @@ def lower(model, num_stages=None) -> StandardForm:
         P[model.var_slice(vname)] += sign * d
 
     rows, los, his = [], [], []
-    for con in model.constraints:
+    con_slices = {}
+    r0 = 0
+    for i, con in enumerate(model.constraints):
         M = np.zeros((con.expr.m, n))
         for vname, B in con.expr.coeffs.items():
             M[:, model.var_slice(vname)] += B
         rows.append(M)
         los.append(con.lo)
         his.append(con.hi)
+        cname = con.name if con.name is not None else f"con{i}"
+        if cname in con_slices:
+            raise ValueError(
+                f"duplicate constraint name {cname!r}: named constraints "
+                "must be unique (they address rows in the vector-patch "
+                "protocol, ir/batch.py build_batch)")
+        con_slices[cname] = slice(r0, r0 + con.expr.m)
+        r0 += con.expr.m
     if rows:
         A = np.concatenate(rows, axis=0)
         l = np.concatenate(los)
@@ -110,5 +124,6 @@ def lower(model, num_stages=None) -> StandardForm:
         P_diag=P, A=A, l=l, u=u, lb=lb, ub=ub,
         integer=integer, stage_of_var=stage_of_var,
         c_stage=c_stage, c0_stage=c0_stage,
-        var_names=names, var_slices=slices, sense=model.sense,
+        var_names=names, var_slices=slices, con_slices=con_slices,
+        sense=model.sense,
     )

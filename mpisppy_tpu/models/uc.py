@@ -183,6 +183,28 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
     return m
 
 
+def scenario_vector_patch(scenario_name, num_gens=10, num_hours=24,
+                          relax_integrality=True, min_up_down=False,
+                          ramping=False):
+    """Structure-shared fast path for build_batch(vector_patch=...): the
+    ONLY scenario-dependent data in a UC scenario is the wind trace,
+    which enters the balance rhs, the reserve rhs, and the spill upper
+    bound. Rebuilding the (m, n) constraint matrix per scenario at
+    reference scale (~90 gens × 48 h, ref. examples/uc/2013-05-11)
+    costs minutes of host time and gigabytes per scenario; this patch
+    costs three vectors. Drift against scenario_creator is caught by
+    build_batch's scenario-0 identity assertion plus
+    tests/test_models.py::test_uc_vector_patch_matches_creator."""
+    import re
+    scennum = int(re.search(r"(\d+)$", scenario_name).group(1))
+    load = load_profile(num_hours, num_gens)
+    wind = wind_scenario(scennum, num_hours, num_gens)
+    return {("l", "balance"): load - wind,
+            ("u", "balance"): load - wind,
+            ("l", "reserve"): (1.0 + RESERVE_FRAC) * load - wind,
+            ("ub", "spill"): np.maximum(wind, 0.0)}
+
+
 def make_tree(num_scens):
     names = [f"scen{i}" for i in range(num_scens)]
     return two_stage_tree(names, nonant_names=["u", "st"])

@@ -61,12 +61,82 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class SplitMatrix(NamedTuple):
+    """Double-float ("df32") matrix: hi + lo ≈ the f64 matrix, both f32.
+
+    TPU MXUs have no f64 datapath — XLA emulates f64 matmuls by
+    splitting BOTH operands into multiple f32 terms and materializing
+    every cross product, which at reference-UC scale (25836 × 13056)
+    exceeds HBM (measured: 17.6 G needed vs 15.75 G for ONE A @ x).
+    The classic double-float compensation (Dekker 1971 two-term split)
+    gets ~2× the f32 mantissa from THREE ordinary f32 MXU passes:
+
+        A @ x ≈ hi @ x_hi + lo @ x_hi + hi @ x_lo      (drop lo·lo)
+
+    with the three f32 products accumulated in f64 (cheap: products are
+    (S, m)-shaped vectors, not matrices). Input quantization error
+    drops from ~6e-8 to ~4e-15 relative; what remains is the f32
+    accumulation noise of each pass (~1e-7 relative, sqrt(n)·eps32),
+    which sets the ADMM residual floor — measured ample for the 1e-4
+    solver-grade target where plain f32 plateaus at ~1e-2. This is the
+    kernel's big-instance representation: no f64 copy of A ever sits
+    in HBM and no emulated-f64 matmul is ever compiled."""
+    hi: jax.Array
+    lo: jax.Array
+
+    @property
+    def ndim(self):
+        return self.hi.ndim
+
+    @property
+    def shape(self):
+        return self.hi.shape
+
+    @property
+    def dtype(self):
+        # the VALUE dtype the pair represents (consumers dispatch on it)
+        return jnp.float64
+
+
+def split_f32(a) -> SplitMatrix:
+    """Two-term split of an f64 array (hi = f32 round, lo = residual)."""
+    hi = a.astype(jnp.float32)
+    lo = (a - hi.astype(jnp.float64)).astype(jnp.float32)
+    return SplitMatrix(hi, lo)
+
+
+def split_f32_np(a):
+    """Host-numpy twin of split_f32 (the ONE split convention — data
+    shipping and tests must not re-derive it). Returns (hi, lo)."""
+    a = np.asarray(a, np.float64)
+    hi = a.astype(np.float32)
+    lo = (a - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def merged64(A):
+    """The f64 value of a SplitMatrix (or a plain array cast to f64).
+    Materializes (m, n) in f64 — use only inside fused elementwise/
+    reduce computations or on host."""
+    if isinstance(A, SplitMatrix):
+        return A.hi.astype(jnp.float64) + A.lo.astype(jnp.float64)
+    return A.astype(jnp.float64) if hasattr(A, "astype") else A
+
+
+def host_dense_A(A):
+    """Host numpy f64 of a QPData.A under any representation."""
+    if isinstance(A, SplitMatrix):
+        return np.asarray(A.hi, np.float64) + np.asarray(A.lo, np.float64)
+    return np.asarray(A, np.float64)
+
+
 class QPData(NamedTuple):
     """Stacked problem data; leading axis S = scenarios. ``A`` and
     ``P_diag`` may be unbatched ((m, n) / (n,)) when shared across the
-    batch — see the module docstring."""
+    batch — see the module docstring. A shared ``A`` may further be a
+    SplitMatrix (df32 big-instance representation)."""
     P_diag: jax.Array   # (S, n) or (n,) shared
-    A: jax.Array        # (S, m, n) or (m, n) shared
+    A: jax.Array        # (S, m, n) or (m, n) shared; maybe SplitMatrix
     l: jax.Array        # (S, m)
     u: jax.Array        # (S, m)
     lb: jax.Array       # (S, n)
@@ -104,24 +174,42 @@ class QPState(NamedTuple):
 
 
 def _Ax(A, x):
-    """A x with A (m,n) shared or (S,m,n) batched; x (S,n) -> (S,m)."""
+    """A x with A (m,n) shared, (S,m,n) batched, or SplitMatrix (df32);
+    x (S,n) -> (S,m). The split path runs three f32 MXU passes and
+    accumulates in f64 (see SplitMatrix)."""
+    if isinstance(A, SplitMatrix):
+        xh = x.astype(jnp.float32)
+        xl = (x - xh.astype(jnp.float64)).astype(jnp.float32)
+        f64 = jnp.float64
+        return ((xh @ A.hi.T).astype(f64) + (xh @ A.lo.T).astype(f64)
+                + (xl @ A.hi.T).astype(f64))
     if A.ndim == 2:
         return x @ A.T
     return jnp.einsum("smn,sn->sm", A, x)
 
 
 def _ATy(A, y):
-    """Aᵀ y with A (m,n) shared or (S,m,n) batched; y (S,m) -> (S,n)."""
+    """Aᵀ y with A (m,n) shared, (S,m,n) batched, or SplitMatrix;
+    y (S,m) -> (S,n)."""
+    if isinstance(A, SplitMatrix):
+        yh = y.astype(jnp.float32)
+        yl = (y - yh.astype(jnp.float64)).astype(jnp.float32)
+        f64 = jnp.float64
+        return ((yh @ A.hi).astype(f64) + (yh @ A.lo).astype(f64)
+                + (yl @ A.hi).astype(f64))
     if A.ndim == 2:
         return y @ A
     return jnp.einsum("smn,sm->sn", A, y)
+
+
 
 
 def _ruiz_equilibrate(P_diag, A, iters=15):
     """Modified Ruiz equilibration of the KKT matrix [[P, Āᵀ],[Ā, 0]] with
     Ā = [A; I] — the identity (bound-row) block is handled analytically:
     its scaled row j is the single value g_j = Eb_j·D_j. Returns (D, E, Eb)
-    with scaled P = D P D (diag), A = E A D, bound rows = diag(Eb·D)."""
+    with scaled P = D P D (diag), A = E A D, bound rows = diag(Eb·D).
+    df32 callers pass the f32 hi part (see _qp_setup_split)."""
     n = A.shape[-1]
     m = A.shape[-2]
     bshape = A.shape[:-2]
@@ -170,6 +258,8 @@ def _factorize(factors: QPFactors, rho_scale):
     A_s, P_s = factors.A_s, factors.P_s
     g = factors.Eb * factors.D
     n = A_s.shape[-1]
+    if isinstance(A_s, SplitMatrix):
+        return _factorize_split(factors, rho_scale)
     invert = A_s.dtype == jnp.float64
     if A_s.ndim == 2:
         rA = factors.rho_A * rho_scale
@@ -196,6 +286,32 @@ def _factorize(factors: QPFactors, rho_scale):
     w = jax.lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
     return jax.lax.linalg.triangular_solve(L, w, left_side=True,
                                            lower=True, transpose_a=True)
+
+
+def _factorize_split(factors: QPFactors, rho_scale):
+    """df32 factorization: a plain f32 Cholesky factor of M, built from
+    ONE f32 MXU pass — no f64 matmul (which would OOM at big-instance
+    scale, see SplitMatrix), no host roundtrip, fully traceable (so
+    in-jit rho refactorization stays available).
+
+    The factor is a PRECONDITIONER-quality object, not the solver: the
+    df32 x-update (see _m_solve_ir in _solve_impl) wraps each
+    triangular solve in mixed-precision iterative refinement whose
+    residuals come from split-f32 matvecs with f64 accumulation. The
+    f32 quantization of M and the κ(M)·eps32 solve error are both
+    corrected by the refinement — the classic IR contraction argument
+    (error × κ·eps32 per sweep) that Newton–Schulz on an explicit
+    inverse does NOT enjoy here (measured: split-product cancellation
+    noise ~κ·1e-7 makes Newton DEGRADE a 2e-5 seed to 7e-3)."""
+    A_s, P_s = factors.A_s, factors.P_s
+    f32 = jnp.float32
+    g32 = (factors.Eb * factors.D).astype(f32)
+    rA32 = (factors.rho_A * rho_scale).astype(f32)
+    rB32 = (factors.rho_b * rho_scale).astype(f32)
+    M32 = A_s.hi.T @ (rA32[:, None] * A_s.hi)
+    M32 = M32 + jnp.diag(P_s.astype(f32) + jnp.asarray(factors.sigma, f32)
+                         + g32 * g32 * rB32)
+    return jnp.linalg.cholesky(M32)
 
 
 def _device_f64_linalg_trusted():
@@ -241,6 +357,9 @@ def _factorize_host(factors: QPFactors, rho_scale, rows=None):
     return jnp.asarray(np.linalg.inv(M))
 
 
+_factorize_jit = jax.jit(_factorize)
+
+
 def factorize_dispatch(factors: QPFactors, rho_scale):
     """The ONE eager factorization entry: host-exact inverse on
     untrusted-f64 backends, device path otherwise. Every eager
@@ -249,7 +368,7 @@ def factorize_dispatch(factors: QPFactors, rho_scale):
     inverse (see _device_f64_linalg_trusted)."""
     if _needs_host_factor(factors):
         return _factorize_host(factors, rho_scale)
-    return _factorize(factors, rho_scale)
+    return _factorize_jit(factors, rho_scale)
 
 
 def _tri_solve(L, b):
@@ -266,31 +385,32 @@ def _tri_solve(L, b):
 def _chol_solve(F, b):
     """Solve M x = b given _factorize's output F: an explicit inverse in
     f64 (one MXU matmul — M⁻¹ is symmetric) or a Cholesky factor in f32
-    (triangular solves; see _factorize's docstring for why)."""
+    (triangular solves; see _factorize's docstring for why). An f64 b
+    against an f32 factor (the df32 x-update seed) solves in f32 and
+    returns f64 — the refinement sweeps in _m_solve_ir own the
+    accuracy."""
     if F.dtype == jnp.float64:
         if F.ndim == 2:
             return b @ F
         return jnp.einsum("sij,sj->si", F, b)
+    out_dt = b.dtype
+    b = b.astype(F.dtype)
     if F.ndim == 2:
         y = jax.lax.linalg.triangular_solve(F, b.T, left_side=True,
                                             lower=True, transpose_a=False)
         x = jax.lax.linalg.triangular_solve(F, y, left_side=True,
                                             lower=True, transpose_a=True)
-        return x.T
-    return _tri_solve(F, b)
+        return x.T.astype(out_dt)
+    return _tri_solve(F, b).astype(out_dt)
 
 
-@partial(jax.jit, static_argnames=("eq_boost",))
-def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
-    """Equilibrate and scale. Cheap relative to the solve; re-solves with a
-    new q reuse everything. The equality-row rho boost pattern depends only
-    on which rows/columns are pinned (l==u / lb==ub), so one setup serves
-    every PH iteration of a mode."""
-    P_diag, A, l, u, lb, ub = data
-    dt = A.dtype
-    shared = A.ndim == 2
-    D, E, Eb = _ruiz_equilibrate(P_diag, A)
-    A_s = E[..., :, None] * A * D[..., None, :]
+@partial(jax.jit, static_argnames=("eq_boost", "shared"))
+def _setup_from_scaled(data: QPData, A_s, D, E, Eb, q_ref, rho_base, sigma,
+                       eq_boost, shared):
+    """Everything in qp_setup AFTER the scaled matrix exists: cost
+    normalization + equality-boost rho patterns (vector math only)."""
+    P_diag, _, l, u, lb, ub = data
+    dt = D.dtype
     P_s = D * P_diag * D
     # cost normalization (OSQP sec 5.1): scale so the objective gradient is O(1)
     if q_ref is None:
@@ -324,6 +444,92 @@ def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6, eq_boost=1e3):
     return QPFactors(sigma=jnp.asarray(sigma, dt), D=D, E=E, Eb=Eb,
                      cost_scale=cost_scale, A_s=A_s, P_s=P_s,
                      rho_A=rho_A, rho_b=rho_b)
+
+
+@partial(jax.jit, static_argnames=("eq_boost",))
+def _qp_setup_dense(data: QPData, q_ref, rho_base, sigma, eq_boost):
+    P_diag, A, *_ = data
+    D, E, Eb = _ruiz_equilibrate(P_diag, A)
+    A_s = E[..., :, None] * A * D[..., None, :]
+    return _setup_from_scaled(data, A_s, D, E, Eb, q_ref, rho_base, sigma,
+                              eq_boost, A.ndim == 2)
+
+
+@partial(jax.jit, static_argnames=("nblocks",))
+def _scale_split_blocks(A: SplitMatrix, D, E, nblocks=8) -> SplitMatrix:
+    """A_s = split(E·A·D) computed in ROW BLOCKS so the f64 value of
+    the scaled matrix only ever exists one block at a time — the
+    full-matrix form materializes several (m, n) f64 transients and
+    OOMs a 16 G chip at reference-UC scale (measured)."""
+    m = A.hi.shape[0]
+    his, los = [], []
+    bounds = [(m * i) // nblocks for i in range(nblocks + 1)]
+    for i in range(nblocks):
+        sl = slice(bounds[i], bounds[i + 1])
+        blk = (A.hi[sl].astype(jnp.float64)
+               + A.lo[sl].astype(jnp.float64)) \
+            * E[sl, None] * D[None, :]
+        hi = blk.astype(jnp.float32)
+        los.append((blk - hi.astype(jnp.float64)).astype(jnp.float32))
+        his.append(hi)
+    return SplitMatrix(jnp.concatenate(his), jnp.concatenate(los))
+
+
+def _qp_setup_split(data: QPData, q_ref, rho_base, sigma, eq_boost):
+    """df32 setup: Ruiz on the f32 hi part (D/E/Eb are heuristic
+    scalings — a 1e-7-relative view of |A| changes nothing), scaled
+    split built blockwise, vector tail shared with the dense path."""
+    A = data.A
+    f64 = jnp.float64
+    D32, E32, Eb32 = _ruiz_equilibrate(data.P_diag.astype(jnp.float32),
+                                       A.hi)
+    D, E, Eb = D32.astype(f64), E32.astype(f64), Eb32.astype(f64)
+    A_s = _scale_split_blocks(A, D, E)
+    return _setup_from_scaled(data, A_s, D, E, Eb, q_ref, rho_base,
+                              sigma, eq_boost, True)
+
+
+def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6,
+             eq_boost=1e3):
+    """Equilibrate and scale. Cheap relative to the solve; re-solves with a
+    new q reuse everything. The equality-row rho boost pattern depends only
+    on which rows/columns are pinned (l==u / lb==ub), so one setup serves
+    every PH iteration of a mode."""
+    if isinstance(data.A, SplitMatrix):
+        return _qp_setup_split(data, q_ref, rho_base, sigma, eq_boost)
+    return _qp_setup_dense(data, q_ref, rho_base, sigma, eq_boost)
+
+
+@partial(jax.jit, static_argnames=("eq_boost",))
+def qp_setup_like(base: QPFactors, data: QPData, rho_base=0.1,
+                  eq_boost=1e3):
+    """Factors for a RELATED mode (prox on/off, pinned boxes) REUSING
+    ``base``'s equilibration and scaled matrix: only the scaled
+    quadratic diagonal and the rho boost patterns are recomputed
+    (vector math). The Ruiz scalings are heuristic — a mode whose P
+    differs on a diagonal block is equally well served by the base
+    mode's D/E — while a per-mode re-setup would duplicate the scaled
+    (m, n) matrix per mode, which at big-instance (df32) scale is
+    gigabytes of HBM per mode (the reason this exists)."""
+    P_diag, _, l, u, lb, ub = data
+    shared = base.A_s.ndim == 2
+    csx = base.cost_scale if shared else base.cost_scale[:, None]
+    P_s = base.D * P_diag * base.D * csx
+
+    def _is_eq(lo, hi):
+        d_ = hi - lo
+        return jnp.isfinite(d_) & (jnp.abs(d_)
+                                   <= 1e-9 * (1.0 + jnp.abs(hi)))
+
+    is_eq = _is_eq(l, u)
+    is_eq_b = _is_eq(lb, ub)
+    if shared:
+        is_eq = jnp.all(is_eq, axis=0)
+        is_eq_b = jnp.all(is_eq_b, axis=0)
+    dt = base.D.dtype
+    rho_A = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
+    rho_b = jnp.where(is_eq_b, rho_base * eq_boost, rho_base).astype(dt)
+    return base._replace(P_s=P_s, rho_A=rho_A, rho_b=rho_b)
 
 
 def qp_reset_rho(factors: QPFactors, state: QPState) -> QPState:
@@ -428,6 +634,13 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
     """
     sigma, D, E, Eb, cs, A_s, P_s, rho_A, rho_b = factors
     shared = A_s.ndim == 2
+    if isinstance(A_s, SplitMatrix):
+        # the polish broadcasts A_s per scenario ((S, n, n) penalty
+        # factors) — structurally impossible at the scale the df32
+        # representation exists for; duals come from the ADMM iterates
+        # (still a VALID bound via qp_dual_objective) and exact
+        # tightening, when needed, from the host oracle
+        polish = False
     g = Eb * D
     l_s, u_s = E * data.l, E * data.u
     lb_s, ub_s = Eb * data.lb, Eb * data.ub
@@ -443,12 +656,33 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
         rs = rho_scale if shared else rho_scale[:, None]
         return rho_A * rs, rho_b * rs
 
+    def _m_solve_ir(L, rhs, rA, rB):
+        """df32 x-update: f32 triangular solves + two sweeps of
+        mixed-precision iterative refinement. The residual r = rhs − Mx
+        is computed through the SPLIT matvecs (f64 accumulation of f32
+        MXU passes), so each sweep contracts the error by ~κ(M)·eps32 —
+        the standard IR argument — landing well below the ADMM
+        tolerance without a single f64 matmul. M is applied in factored
+        form (P, σ, A_sᵀρA_s, bound rows); no (n, n) product is ever
+        stored."""
+        def m_apply(v):
+            return P_s * v + sigma * v + _ATy(A_s, rA * _Ax(A_s, v)) \
+                + g * g * rB * v
+
+        x = _chol_solve(L, rhs)
+        for _ in range(2):
+            x = x + _chol_solve(L, rhs - m_apply(x))
+        return x
+
     def admm_chunk(x, yA, yB, zA, zB, L, rA, rB):
+        split_mode = isinstance(A_s, SplitMatrix)
+
         def one(carry, _):
             x, yA, yB, zA, zB = carry
             rhs = sigma * x - q_s + _ATy(A_s, rA * zA - yA) \
                 + g * (rB * zB - yB)
-            x_t = _chol_solve(L, rhs)
+            x_t = _m_solve_ir(L, rhs, rA, rB) if split_mode \
+                else _chol_solve(L, rhs)
             x_new = alpha * x_t + (1 - alpha) * x
             zA_t = _Ax(A_s, x_t)
             zA_mix = alpha * zA_t + (1 - alpha) * zA
@@ -783,10 +1017,20 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     as qp_solve, with the state in f64.
     """
     lo = jnp.float32
-    f_lo = _cast_floats(factors, lo)
-    d_lo = _cast_floats(data, lo)
+    # df32 factors/data carry SplitMatrix A — the f32 bulk phase wants
+    # the PLAIN hi part (one MXU pass per matvec, not three) and a plain
+    # f32 Cholesky factor
+    factors_lo_src = factors._replace(A_s=factors.A_s.hi) \
+        if isinstance(factors.A_s, SplitMatrix) else factors
+    data_lo_src = data._replace(A=data.A.hi) \
+        if isinstance(data.A, SplitMatrix) else data
+    f_lo = _cast_floats(factors_lo_src, lo)
+    d_lo = _cast_floats(data_lo_src, lo)
     st_lo = _cast_floats(state, lo)
-    st_lo = st_lo._replace(L=_factorize(f_lo, st_lo.rho_scale))
+    # jitted: the eager path materializes every factorization transient
+    # (the weighted matrix, the product, the factor) as separate
+    # buffers — at big-instance scale that is ~4 GB of avoidable peak
+    st_lo = st_lo._replace(L=_factorize_jit(f_lo, st_lo.rho_scale))
     # the f32 phase is a WARM START for the f64 phase: stop it at its
     # noise floor (~1e-3 relative on badly-scaled LPs) — iterating f32
     # past that treads water and, worse, feeds the rho adaptation noise
@@ -816,8 +1060,20 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
             break
     dt_hi = state.x.dtype
     rho_hi = st_lo.rho_scale.astype(dt_hi)
-    st_hi = _cast_floats(st_lo, dt_hi)._replace(
-        L=factorize_dispatch(factors, rho_hi), rho_scale=rho_hi)
+    # swap L out for a scalar before the cast: _cast_floats would
+    # otherwise materialize a throwaway f64 copy of the (n, n) factor
+    L_lo = st_lo.L
+    st_hi = _cast_floats(st_lo._replace(L=jnp.zeros((), jnp.float32)),
+                         dt_hi)
+    if isinstance(factors.A_s, SplitMatrix):
+        # the df32 tail's factor IS an f32 Cholesky of the same M at
+        # the same (adapted) rho the bulk phase ended on — reuse it
+        # instead of recomputing (the factorization's (n, n) transients
+        # are the biggest allocations in the whole solve path)
+        L_hi = L_lo
+    else:
+        L_hi = factorize_dispatch(factors, rho_hi)
+    st_hi = st_hi._replace(L=L_hi, rho_scale=rho_hi)
     # the f64 tail is the real solver: full termination test, rho
     # adaptation on (it refactorizes in f64 when needed), early exit when
     # the warm start was already good (prox-regularized solves)

@@ -79,7 +79,8 @@ def pad_batch_for_mesh(batch, n_shards: int):
     return dataclasses.replace(
         batch, tree=new_tree,
         c=pad(batch.c), c0=pad(batch.c0), P_diag=pad(batch.P_diag),
-        A=pad(batch.A), l=pad(batch.l), u=pad(batch.u),
+        A=batch.A if batch.shared_A else pad(batch.A),
+        l=pad(batch.l), u=pad(batch.u),
         lb=pad(batch.lb), ub=pad(batch.ub),
         c_stage=pad(batch.c_stage), c0_stage=pad(batch.c0_stage),
         prob=new_tree.probabilities.copy(),

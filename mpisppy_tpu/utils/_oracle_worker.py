@@ -89,7 +89,10 @@ def solve_scenario(state: dict, task):
     """Solve one scenario LP/MILP: min q·x s.t. l<=Ax<=u, lb<=x<=ub
     (+ integrality when milp=True).
 
-    task = (s, q, milp, time_limit, mip_gap[, want_x]).
+    task = (s, q, milp, time_limit, mip_gap[, want_x[, fixed]]).
+    ``fixed`` — optional (idx, vals) pinning columns idx at vals via
+    lb=ub (incumbent evaluation: first-stage nonants fixed at a
+    candidate x̂, the dispatch solved exactly on host).
     Returns (s, value, ok, optimal, primal):
       value — a certified LOWER bound on the scenario minimum (the LP
         optimum, or HiGHS's B&B dual bound for MILPs — valid even when
@@ -105,17 +108,24 @@ def solve_scenario(state: dict, task):
 
     s, q, want_milp, time_limit, mip_gap = task[:5]
     want_x = bool(task[5]) if len(task) > 5 else False
+    fixed = task[6] if len(task) > 6 else None
     integrality = state["integrality"] if want_milp else None
     opts = {"presolve": True}
     if time_limit is not None:
         opts["time_limit"] = float(time_limit)
     if want_milp and mip_gap is not None:
         opts["mip_rel_gap"] = float(mip_gap)
+    lb, ub = state["lb"][s], state["ub"][s]
+    if fixed is not None:
+        idx, vals = fixed
+        lb, ub = lb.copy(), ub.copy()
+        lb[idx] = vals
+        ub[idx] = vals
     res = _milp(
         q,
         constraints=LinearConstraint(_A_of(state, s),
                                      state["l"][s], state["u"][s]),
-        bounds=Bounds(state["lb"][s], state["ub"][s]),
+        bounds=Bounds(lb, ub),
         integrality=(integrality if integrality is not None
                      else np.zeros(q.shape[0], dtype=np.uint8)),
         options=opts,

@@ -281,6 +281,50 @@ class OraclePool:
             return vals, ok, opt, xs
         return vals, ok, opt
 
+    def incumbent_value(self, xhat, prob, milp=None, time_limit=None,
+                        mip_gap=None, kill_check=None, pin_mask=None):
+        """EXACT expected objective of candidate first-stage plan
+        ``xhat`` ((K,) or (S, K), fixed on the nonant columns): one
+        host solve per scenario with lb=ub pinned — the certified
+        INNER-bound evaluator for scales where the device evaluator's
+        tolerance-level feasibility can mis-state penalty-dominated
+        objectives by (violation × penalty) (see doc/tpu_numerics.md).
+        ``milp`` defaults to True exactly when integer RECOURSE columns
+        exist (first-stage integrality is already pinned by x̂).
+        Returns the expected objective, or None on any infeasible /
+        unfinished scenario or kill."""
+        if self.nonant_idx is None:
+            raise ValueError("this pool has no nonant index map")
+        idx = np.asarray(self.nonant_idx)
+        xhat = np.asarray(xhat, dtype=np.float64)
+        if xhat.ndim == 1:
+            xhat = np.broadcast_to(xhat, (self.S, idx.size))
+        if pin_mask is not None:
+            # pin only the deciding slots (see PHBase.calculate_incumbent
+            # pin_mask) — derived nonants are left to the exact solve
+            pm = np.asarray(pin_mask, bool)
+            idx = idx[pm]
+            xhat = xhat[:, pm]
+        if milp is None:
+            # conservative default: any integer column NOT pinned by x̂
+            # forces a MILP (callers who know the unpinned integers are
+            # DERIVED — integral at the LP optimum, e.g. UC startups
+            # under positive startup costs — pass milp=False)
+            rec = np.asarray(self._payload["integrality"], bool).copy()
+            rec[idx] = False
+            milp = bool(rec.any())
+        tasks = [(s, self.c[s].copy(), bool(milp), time_limit, mip_gap,
+                  False, (idx, xhat[s])) for s in range(self.S)]
+        results = self._run(tasks, kill_check)
+        if results is None:
+            return None
+        vals = np.full(self.S, np.nan)
+        for s, v, ok, is_opt, _ in results:
+            if not (ok and is_opt):
+                return None
+            vals[s] = v + self.c0[s]
+        return float(np.dot(np.asarray(prob, dtype=np.float64), vals))
+
     def lagrangian_bound(self, prob, W=None, milp=False, time_limit=None,
                          mip_gap=None, kill_check=None):
         """E_p[scenario value with W] — the exact (LP) or MIP-tight

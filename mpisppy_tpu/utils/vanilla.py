@@ -27,7 +27,11 @@ def _pop_dtype(options):
 
 
 def build_batch_for(cfg: RunConfig):
-    """Model registry: name -> stacked batch (+ bundling)."""
+    """Model registry: name -> stacked batch (+ bundling). Models that
+    export ``scenario_vector_patch`` get the structure-shared fast path
+    (ir/batch.py build_batch(vector_patch=...)) automatically — at
+    reference-UC scale that is the difference between one template
+    lowering and S of them."""
     from ..ir.batch import build_batch
     from .. import models
 
@@ -50,15 +54,19 @@ def build_batch_for(cfg: RunConfig):
         kwargs.update(tk)
     else:
         tree = mod.make_tree(cfg.num_scens)
-    batch = build_batch(mod.scenario_creator, tree, creator_kwargs=kwargs)
+    batch = build_batch(mod.scenario_creator, tree, creator_kwargs=kwargs,
+                        vector_patch=getattr(mod, "scenario_vector_patch",
+                                             None))
     if cfg.num_bundles:
         from ..core.bundles import form_bundles
         batch = form_bundles(batch, cfg.num_bundles)
     return batch
 
 
-def hub_dict(cfg: RunConfig):
-    """ref. vanilla.py:54 ph_hub (+ aph/lshaped variants)."""
+def hub_dict(cfg: RunConfig, batch=None):
+    """ref. vanilla.py:54 ph_hub (+ aph/lshaped variants). ``batch``:
+    optionally a prebuilt batch shared across cylinders (engines never
+    mutate the host arrays; wheel_dicts passes one build to all)."""
     from ..core.ph import PH
     from ..core.aph import APH
     from ..core.lshaped import LShapedMethod
@@ -84,7 +92,8 @@ def hub_dict(cfg: RunConfig):
         opt_cls, hub_cls = LShapedMethod, LShapedHub
     return {"hub_class": hub_cls, "hub_kwargs": hub_kwargs,
             "opt_class": opt_cls,
-            "opt_kwargs": {"batch": build_batch_for(cfg),
+            "opt_kwargs": {"batch": batch if batch is not None
+                           else build_batch_for(cfg),
                            "options": options, **dtype_kw}}
 
 
@@ -122,7 +131,7 @@ def spoke_classes(kind: str):
     }[kind]
 
 
-def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
+def spoke_dict(cfg: RunConfig, sp: SpokeConfig, batch=None):
     """ref. vanilla.py:95-408 — one factory per spoke kind."""
     spoke_cls, opt_cls = spoke_classes(sp.kind)
     options = cfg.algo.to_options()
@@ -133,11 +142,18 @@ def spoke_dict(cfg: RunConfig, sp: SpokeConfig):
         spoke_kwargs["trace_prefix"] = cfg.trace_prefix
     return {"spoke_class": spoke_cls, "spoke_kwargs": spoke_kwargs,
             "opt_class": opt_cls,
-            "opt_kwargs": {"batch": build_batch_for(cfg),
+            "opt_kwargs": {"batch": batch if batch is not None
+                           else build_batch_for(cfg),
                            "options": options, **dtype_kw}}
 
 
 def wheel_dicts(cfg: RunConfig):
-    """The full (hub_dict, spoke_dicts) pair for spin_the_wheel."""
+    """The full (hub_dict, spoke_dicts) pair for spin_the_wheel. The
+    batch is built ONCE and shared by every cylinder (engines read the
+    host arrays, they never write them) — at reference-UC scale each
+    template lowering costs ~a minute, so per-cylinder rebuilds would
+    multiply a fixed cost by the wheel width."""
     cfg.validate()
-    return hub_dict(cfg), [spoke_dict(cfg, sp) for sp in cfg.spokes]
+    batch = build_batch_for(cfg)
+    return hub_dict(cfg, batch=batch), \
+        [spoke_dict(cfg, sp, batch=batch) for sp in cfg.spokes]

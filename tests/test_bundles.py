@@ -70,7 +70,7 @@ def test_unbundle_roundtrip():
     assert np.allclose(x[2, idx], x[3, idx])
     # and each scenario's rows are feasible at the unbundled data
     for s in range(4):
-        Ax = np.asarray(batch.A[s]) @ x[s]
+        Ax = np.asarray(batch.A_of(s)) @ x[s]
         scale = 1.0 + np.maximum(
             np.where(np.isfinite(batch.l[s]), np.abs(batch.l[s]), 0.0),
             np.where(np.isfinite(batch.u[s]), np.abs(batch.u[s]), 0.0))

@@ -263,3 +263,28 @@ def test_spoke_mip_oracle_publishes_tighter_bound(ph_state):
         assert mip_bound <= ef_obj + 1e-6 * abs(ef_obj)
     finally:
         sp.finalize()
+
+
+def test_incumbent_value_exact_and_valid(ph_state):
+    """incumbent_value pins the nonants and solves the dispatch
+    host-exactly: the returned expected objective is a TRUE upper bound
+    (>= the integer EF optimum) and agrees with the device evaluator to
+    its tolerance; an infeasible candidate returns None."""
+    b, W, ef_obj = ph_state
+    ph = PHBase(b, {"defaultPHrho": 50.0, "subproblem_max_iter": 1500,
+                    "subproblem_eps": 1e-7})
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    cands, feas = ph.dive_nonant_candidates(np.asarray(ph.xbar))
+    k = int(np.flatnonzero(feas)[0])
+    xhat = ph.round_nonants(cands[k])
+    pool = OraclePool(b, n_workers=0)
+    exact = pool.incumbent_value(xhat, b.prob)
+    assert exact is not None
+    assert exact >= ef_obj - 1e-6 * abs(ef_obj)       # true upper bound
+    dev = ph.calculate_incumbent(xhat)
+    assert dev == pytest.approx(exact, rel=5e-3)
+    # an absurd candidate (commit nothing) is infeasible: reserve rows
+    # cannot be covered -> None, never a fake bound
+    assert pool.incumbent_value(np.zeros_like(xhat), b.prob) is None
+    pool.close()

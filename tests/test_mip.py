@@ -58,7 +58,7 @@ def test_sizes3_device_dive_feasible_with_bounded_gap():
     # not through round-tripping the snap)
     b = ef2.batch
     for s in range(b.S):
-        Ax = np.asarray(b.A[s]) @ xb[s]
+        Ax = np.asarray(b.A_of(s)) @ xb[s]
         scale = 1.0 + np.maximum(
             np.where(np.isfinite(b.l[s]), np.abs(b.l[s]), 0.0),
             np.where(np.isfinite(b.u[s]), np.abs(b.u[s]), 0.0))

@@ -30,7 +30,7 @@ def ef_linprog(batch):
     A_ub_blocks, b_ub = [], []
     A_eq_blocks, b_eq = [], []
     for s in range(S):
-        A, l, u = batch.A[s], batch.l[s], batch.u[s]
+        A, l, u = batch.A_of(s), batch.l[s], batch.u[s]
         eq = np.isfinite(l) & np.isfinite(u) & (l == u)
         ub_rows = np.isfinite(u) & ~eq
         lb_rows = np.isfinite(l) & ~eq
@@ -160,6 +160,34 @@ def test_battery_flow_balance_at_opt():
         assert np.max(np.abs(resid)) < 1e-3
 
 
+def test_uc_vector_patch_matches_creator():
+    """The structure-shared fast path (build_batch(vector_patch=...))
+    reproduces the full per-scenario-creator batch EXACTLY — every
+    vector field, with the constraint matrix stored once. This is the
+    drift guard that lets reference-scale benches trust the patch."""
+    import numpy as np
+    from mpisppy_tpu.models import uc as ucm
+
+    for kw in ({"num_gens": 3, "num_hours": 8},
+               {"num_gens": 4, "num_hours": 6, "min_up_down": True,
+                "ramping": True, "relax_integrality": False}):
+        full = build_batch(ucm.scenario_creator, ucm.make_tree(5),
+                           creator_kwargs=kw)
+        fast = build_batch(ucm.scenario_creator, ucm.make_tree(5),
+                           creator_kwargs=kw,
+                           vector_patch=ucm.scenario_vector_patch)
+        assert fast.shared_A
+        # the full path auto-compacts shared A too
+        assert full.shared_A
+        np.testing.assert_array_equal(np.asarray(fast.A),
+                                      np.asarray(full.A))
+        for fld in ("c", "c0", "P_diag", "l", "u", "lb", "ub",
+                    "c_stage", "c0_stage", "prob"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(fast, fld)),
+                np.asarray(getattr(full, fld)), err_msg=fld)
+
+
 def test_uc_min_up_down_and_ramping():
     """The optional Rajan-Takriti windows and ramp rows: structure, the
     constrained optimum dominates the base one, and a fast-cycling
@@ -182,7 +210,7 @@ def test_uc_min_up_down_and_ramping():
     # after the base block) on a crafted commitment
     ut, dt_ = ucm.min_up_down_times(G)
     assert ut[0] >= 4 and ut[-1] == 1     # slow baseload, fast peaker
-    A = np.asarray(b1.A)[0]
+    A = np.asarray(b1.A_of(0))
     n = b1.n
     x = np.zeros(n)
     u = np.zeros((G, T))

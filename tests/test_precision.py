@@ -26,7 +26,7 @@ def _uc_batch(S=4, G=3, T=6, integer=False):
 
 
 def _qp(batch, dtype):
-    A0 = jnp.asarray(np.asarray(batch.A)[0], dtype)
+    A0 = jnp.asarray(np.asarray(batch.A_of(0)), dtype)
     P0 = jnp.asarray(np.asarray(batch.P_diag)[0], dtype)
     data = QPData(P0, A0, jnp.asarray(batch.l, dtype),
                   jnp.asarray(batch.u, dtype), jnp.asarray(batch.lb, dtype),
@@ -144,6 +144,154 @@ def test_ph_precision_mixed_requires_f64():
     with pytest.raises(ValueError):
         PHBase(_uc_batch(), {"subproblem_precision": "mixed"},
                dtype=jnp.float32)
+
+
+def _split_qp(batch):
+    """QPData with A as a SplitMatrix (the df32 big-instance repr)."""
+    from mpisppy_tpu.ops.qp_solver import SplitMatrix, split_f32_np
+
+    hi, lo = split_f32_np(np.asarray(batch.A_of(0), np.float64))
+    dt = jnp.float64
+    data = QPData(jnp.asarray(np.asarray(batch.P_diag)[0], dt),
+                  SplitMatrix(jnp.asarray(hi), jnp.asarray(lo)),
+                  jnp.asarray(batch.l, dt), jnp.asarray(batch.u, dt),
+                  jnp.asarray(batch.lb, dt), jnp.asarray(batch.ub, dt))
+    q = jnp.asarray(batch.c, dt)
+    return data, q, qp_setup(data, q_ref=q)
+
+
+def test_df32_split_matvec_accuracy():
+    """The three-pass split matvec agrees with exact f64 to the f32
+    accumulation floor (~1e-7 relative), far below plain-f32 input
+    quantization + accumulation at UC-like magnitudes."""
+    from mpisppy_tpu.ops.qp_solver import SplitMatrix, _Ax, split_f32
+
+    rng = np.random.RandomState(0)
+    A = rng.randn(400, 300) * np.exp(rng.randn(400, 300) * 3)
+    x = rng.randn(5, 300) * 1e3
+    exact = x @ A.T
+    Asp = split_f32(jnp.asarray(A))
+    got = np.asarray(_Ax(Asp, jnp.asarray(x)))
+    plain = np.asarray(_Ax(jnp.asarray(A, jnp.float32),
+                           jnp.asarray(x, jnp.float32)), np.float64)
+    scale = np.abs(exact).max()
+    assert np.abs(got - exact).max() / scale < 1e-6
+    # never worse than plain f32 (the split removes input quantization;
+    # what remains is the shared f32 accumulation noise, whose size
+    # depends on the backend's dot implementation)
+    assert np.abs(got - exact).max() \
+        <= 1.5 * np.abs(plain - exact).max() + 1e-12 * scale
+
+
+def test_df32_factorize_is_f32_preconditioner():
+    """df32 factorization yields a finite f32 Cholesky factor of M —
+    the preconditioner the IR-wrapped x-update refines against (the
+    refinement accuracy itself is covered end-to-end by
+    test_df32_solve_matches_f64)."""
+    from mpisppy_tpu.ops.qp_solver import _factorize, merged64
+
+    b = _uc_batch()
+    data, q, factors = _split_qp(b)
+    L = _factorize(factors, jnp.ones((), jnp.float64))
+    assert L.dtype == jnp.float32
+    assert bool(jnp.isfinite(L).all())
+    A_s64 = np.asarray(merged64(factors.A_s))
+    g = np.asarray(factors.Eb * factors.D)
+    M = A_s64.T @ (np.asarray(factors.rho_A)[:, None] * A_s64) \
+        + np.diag(np.asarray(factors.P_s) + float(factors.sigma)
+                  + g * g * np.asarray(factors.rho_b))
+    rel = np.abs(np.asarray(L, np.float64) @ np.asarray(L, np.float64).T
+                 - M).max() / np.abs(M).max()
+    assert rel < 1e-5
+
+
+def test_df32_solve_matches_f64():
+    """A full df32 escalated solve (f32 bulk on A.hi + split tail)
+    reaches the f64 solution on UC within solver tolerance."""
+    b = _uc_batch()
+    d64, q64, f64f = _qp(b, jnp.float64)
+    st = qp_cold_state(f64f, d64)
+    st, x_ref, _, _ = qp_solve_segmented(f64f, d64, q64, st,
+                                         max_iter=6000, segment=1000,
+                                         eps_abs=1e-8, eps_rel=1e-8)
+    data, q, factors = _split_qp(b)
+    st2 = qp_cold_state(factors, data)
+    st2, x_df, yA, yB = qp_solve_mixed(factors, data, q, st2,
+                                       max_iter=1500, tail_iter=3000,
+                                       eps_abs=1e-7, eps_rel=1e-7)
+    # the df32 residual floor is ~kappa(M) * f32-accumulation-noise
+    # (the IR bound): ~1.5e-4 on this instance — solver-grade for the
+    # PH hub, an order under the pure-f32 plateau
+    assert float(st2.pri_rel.max()) < 3e-4
+    # df32 runs with the polish structurally OFF (its per-scenario
+    # factors are what the representation exists to avoid), so on this
+    # DEGENERATE prox-off LP the objective closes slowly from above —
+    # assert near-feasible near-optimality, not exactness (exact
+    # bounds/incumbents at df32 scale come from the host oracle)
+    from mpisppy_tpu.ops.qp_solver import qp_dual_objective, qp_objective
+    obj_ref = np.asarray(qp_objective(d64, q64, 0.0, x_ref))
+    obj_df = np.asarray(qp_objective(d64, q64, 0.0, x_df))
+    # tolerance-level infeasibility can under- or over-shoot the
+    # optimum by ~(violation × VOLL) on UC's penalty-dominated
+    # objective — ±3% brackets the achievable band at the df32 floor
+    # (exact incumbents/bounds at df32 scale come from the host oracle)
+    np.testing.assert_allclose(obj_df, obj_ref, rtol=3e-2)
+    # certified dual bound from the df32 duals is VALID (<= true min)
+    dual = np.asarray(qp_dual_objective(data, q, 0.0, yA, yB,
+                                        x_witness=x_df))
+    assert (dual <= obj_ref + 1e-4 * np.abs(obj_ref)).all()
+
+
+def test_df32_ph_engine_end_to_end():
+    """PHBase with subproblem_precision='df32': spbase builds the split
+    A, the engine runs the escalated driver, and the trajectory matches
+    a native-f64 engine."""
+    from mpisppy_tpu.ops.qp_solver import SplitMatrix
+
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 1500,
+            "subproblem_eps": 1e-7, "subproblem_tail_iter": 2000}
+    ph64 = PHBase(_uc_batch(S=4), dict(opts), dtype=jnp.float64)
+    phdf = PHBase(_uc_batch(S=4),
+                  {**opts, "subproblem_precision": "df32"},
+                  dtype=jnp.float64)
+    assert isinstance(phdf.qp_data.A, SplitMatrix)
+    # prox-off solves land on different vertices of the degenerate
+    # optimal face per precision mode, and PH's consensus trajectory
+    # amplifies vertex choices — so the comparison is STRUCTURAL:
+    # both engines contract, solve to grade, and price the consensus
+    # within a fraction of a percent after a few iterations
+    for ph in (ph64, phdf):
+        for it in range(4):
+            if it == 0:
+                ph.solve_loop(w_on=False, prox_on=False)
+            else:
+                ph.solve_loop(w_on=True, prox_on=True)
+            ph.W = ph.W_new
+    assert float(np.asarray(phdf._qp_states[True].pri_rel).max()) < 5e-3
+    assert phdf.conv < 10 * max(ph64.conv, 1e-3)
+    # pricing after 4 iterations is sensitive to which optimal vertex
+    # each inexact solve lands on (measured swings of ~0.7% across
+    # benign kernel changes); the band reflects that, the tight
+    # per-solve quality guarantees live in test_df32_solve_matches_f64
+    assert phdf.Eobjective_value() == pytest.approx(
+        ph64.Eobjective_value(), rel=2e-2)
+    # chunked df32 (the production big-instance shape) behaves the same
+    phc = PHBase(_uc_batch(S=4),
+                 {**opts, "subproblem_precision": "df32",
+                  "subproblem_chunk": 2},
+                 dtype=jnp.float64)
+    for it in range(4):
+        if it == 0:
+            phc.solve_loop(w_on=False, prox_on=False)
+        else:
+            phc.solve_loop(w_on=True, prox_on=True)
+        phc.W = phc.W_new
+    assert np.isfinite(phc.conv)
+    # per-chunk rho/warm-start trajectories add another layer of
+    # vertex-choice noise on this degenerate instance — the pricing
+    # band is accordingly wider than the fused engine's
+    assert phc.Eobjective_value() == pytest.approx(
+        ph64.Eobjective_value(), rel=2e-2)
 
 
 def test_exact_oracle_matches_device_bound_on_farmer():

@@ -65,15 +65,44 @@ def test_farmer_wheel_golden():
     _check(res, wall, GOLDEN["farmer"])
 
 
+def _uc10_small_cfg(max_iterations):
+    """The round-3 small-instance headline wheel (10 gens x 24 h,
+    10 scenarios): pure-f32 PH hub + MIP-tight LP-EF-warm-started
+    Lagrangian spoke + dual-purpose host EF-MIP spoke. Kept verbatim
+    from the r3 bench (which now benches the reference-scale instance)
+    so the certified 0.056%-gap circuit cannot rot unnoticed."""
+    fast = {"defaultPHrho": 100.0, "subproblem_max_iter": 2000,
+            "subproblem_eps": 1e-4, "subproblem_eps_hot": 1e-3,
+            "subproblem_eps_dua_hot": 1e-2, "subproblem_stall_rel": 1e-3,
+            "subproblem_segment": 2000, "subproblem_polish_hot": False}
+    return RunConfig(
+        model="uc", num_scens=10,
+        model_kwargs={"num_gens": 10, "num_hours": 24,
+                      "relax_integrality": False},
+        hub="ph",
+        algo=AlgoConfig(default_rho=100.0, max_iterations=max_iterations,
+                        convthresh=-1.0, subproblem_max_iter=2000,
+                        subproblem_eps=1e-6),
+        hub_options={**fast, "dtype": "float32", "iter0_feas_tol": 5e-3},
+        spokes=[SpokeConfig(kind="lagrangian",
+                            options={"dtype": "float64",
+                                     "lagrangian_exact_oracle": True,
+                                     "lagrangian_mip_oracle": True,
+                                     "lagrangian_mip_time_limit": 10.0,
+                                     "lagrangian_mip_gap": 1e-4}),
+                SpokeConfig(kind="efmip",
+                            options={"dtype": "float64",
+                                     "efmip_time_limit": 120.0,
+                                     "efmip_gap": 1e-5})],
+        rel_gap=5e-5)
+
+
 @pytest.mark.slow
 def test_uc10_wheel_golden():
-    """The bench wheel itself (PH hub + MIP-tight warm-started
+    """The r3 headline wheel (PH hub + MIP-tight warm-started
     Lagrangian + host EF-MIP incumbent on 10-scenario integer UC): the
-    certified 0.056% gap and its cadence are the round-3 headline and
-    must not rot."""
-    import bench
-
-    res, wall = _run(bench._gap_cfg(max_iterations=250),
+    certified 0.056% gap and its cadence must not rot."""
+    res, wall = _run(_uc10_small_cfg(max_iterations=250),
                      gap_marks=(0.01, 0.005))
     g = GOLDEN["uc10"]
     _check(res, wall, g)
