@@ -348,27 +348,46 @@ def bench_uc1024_gap():
         rel_gap=0.008)
 
 
-def _wait_for_headroom(min_gb=11.0, timeout=600.0):
+_HEADROOM_PROBE = """
+import time
+import jax, jax.numpy as jnp
+a = jnp.ones((int({gb} * 1e9 / 4),), jnp.float32)
+a.block_until_ready()
+v = float(a[0])
+# free EXPLICITLY while this client is alive (an alive-client free is
+# immediate; memory held at process death lingers for minutes and
+# would itself become the ghost the probe exists to detect)
+a.delete()
+time.sleep(2.0)
+print(v)
+"""
+
+
+def _wait_for_headroom(min_gb=11.0, timeout=900.0):
     """The tunneled TPU worker frees a dead client's HBM with minutes
     of lag; a bench starting into a predecessor's ghost allocations
-    OOMs spuriously. Block until a probe allocation of ``min_gb``
-    succeeds (no-op on healthy starts)."""
-    import jax.numpy as jnp
+    OOMs spuriously. Probe from a THROWAWAY SUBPROCESS: a failed
+    allocation permanently poisons its process (measured: after one
+    failed alloc, every later alloc in that process fails), so the
+    bench process itself must never attempt one that can fail."""
+    import subprocess
 
     t0 = time.perf_counter()
     while True:
         try:
-            a = jnp.ones((int(min_gb * 1e9 / 4),), jnp.float32)
-            a.block_until_ready()
-            float(a[0])
-            del a
+            r = subprocess.run(
+                [sys.executable, "-c", _HEADROOM_PROBE.format(gb=min_gb)],
+                capture_output=True, timeout=420)
+            ok = r.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        if ok:
             return
-        except Exception:
-            if time.perf_counter() - t0 > timeout:
-                _progress("headroom never cleared; proceeding anyway")
-                return
-            _progress("ghost HBM from a dead client; waiting 30 s")
-            time.sleep(30.0)
+        if time.perf_counter() - t0 > timeout:
+            _progress("headroom never cleared; proceeding anyway")
+            return
+        _progress("ghost HBM from a dead client; waiting 30 s")
+        time.sleep(30.0)
 
 
 def main():

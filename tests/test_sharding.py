@@ -59,3 +59,39 @@ def test_padding_for_uneven_scenario_count():
     ph0 = PH(build_batch(farmer.scenario_creator, farmer.make_tree(6)), _opts(2))
     ph0.ph_main()
     assert np.allclose(np.asarray(ph.xbar[0]), np.asarray(ph0.xbar[0]), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_chunked_solve_matches_fused_under_mesh():
+    """The PRODUCTION deployment shape — scenario microbatching
+    (subproblem_chunk < S) — under an 8-device mesh: the chunk loop's
+    cross-shard scenario gathers must reproduce the fused sharded step
+    (VERDICT r3 #4: the chunked path had never executed sharded)."""
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.models import uc
+
+    def mk():
+        return build_batch(
+            uc.scenario_creator, uc.make_tree(8),
+            creator_kwargs={"num_gens": 3, "num_hours": 6},
+            vector_patch=uc.scenario_vector_patch)
+
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 3000,
+            "subproblem_eps": 1e-8}
+    mesh = make_mesh()
+    ph_f = PHBase(mk(), dict(opts), mesh=mesh)
+    ph_c = PHBase(mk(), {**opts, "subproblem_chunk": 4}, mesh=mesh)
+    for ph in (ph_f, ph_c):
+        ph.solve_loop(w_on=False, prox_on=False)
+        ph.W = ph.W_new
+        ph.solve_loop(w_on=True, prox_on=True)
+    np.testing.assert_allclose(np.asarray(ph_c.xbar),
+                               np.asarray(ph_f.xbar), atol=5e-4)
+    assert ph_c.conv == pytest.approx(ph_f.conv, abs=1e-4)
+    # and chunked-under-mesh matches chunked-single-device
+    ph_s = PHBase(mk(), {**opts, "subproblem_chunk": 4})
+    ph_s.solve_loop(w_on=False, prox_on=False)
+    ph_s.W = ph_s.W_new
+    ph_s.solve_loop(w_on=True, prox_on=True)
+    np.testing.assert_allclose(np.asarray(ph_c.xbar),
+                               np.asarray(ph_s.xbar), atol=5e-4)
