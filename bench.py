@@ -380,6 +380,12 @@ def _wait_for_headroom(min_gb=11.0, timeout=900.0):
                 capture_output=True, timeout=420)
             ok = r.returncode == 0
         except subprocess.TimeoutExpired:
+            # the killed child dies holding its allocation — wait the
+            # dead-client release lag out before probing again, or the
+            # probe chases its own ghost
+            _progress("headroom probe timed out; waiting 120 s for the "
+                      "killed probe's HBM to release")
+            time.sleep(120.0)
             ok = False
         if ok:
             return

@@ -502,10 +502,17 @@ class PHBase(SPBase):
                           and isinstance(v, (QPState, _ChunkStateView))),
                          None)
             states = []
+            # ONE cold state serves every chunk: qp_cold_state is zero
+            # iterates + a factor, data-dependent in SHAPE only (chunk
+            # shapes are identical), and immutable buffers make the
+            # sharing safe — at df32 scale each per-chunk factor copy
+            # would cost ~0.7 GB x chunk count
+            idx0 = slices[0][0]
+            st0 = qp_cold_state(factors, data._replace(
+                l=data.l[idx0], u=data.u[idx0],
+                lb=data.lb[idx0], ub=data.ub[idx0]))
             for idx, _ in slices:
-                st = qp_cold_state(factors, data._replace(
-                    l=data.l[idx], u=data.u[idx],
-                    lb=data.lb[idx], ub=data.ub[idx]))
+                st = st0
                 if other is not None and \
                         other.x.shape[0] == self.batch.S and \
                         other.zA.shape[1] == st.zA.shape[1]:
@@ -537,6 +544,8 @@ class PHBase(SPBase):
         slices = self._chunk_index(chunk)
         states = self._ensure_chunk_states(key, factors, data, slices)
         polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
+        from ..ops.qp_solver import SplitMatrix
+        split_mode = isinstance(factors.A_s, SplitMatrix)
         kw = dict(prox_on=bool(prox_on), precision=self.sub_precision,
                   sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
                   sub_eps_hot=self.sub_eps_hot,
@@ -552,6 +561,7 @@ class PHBase(SPBase):
         # decision point over all chunks and keeps objectives computed
         # strictly on accepted solutions — not cross-chunk overlap.)
         solved_chunks = []
+        prev_st = None
         for ci, (idx_c, real) in enumerate(slices):
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
                                 lb=data.lb[idx_c], ub=data.ub[idx_c])
@@ -562,8 +572,26 @@ class PHBase(SPBase):
                                     self._fixed_mask[idx_c],
                                     self._fixed_vals[idx_c], ws,
                                     w_on=bool(w_on), prox_on=bool(prox_on))
-            st, x, yA, yB = _solver_call(factors, d_c, q_c, states[ci],
-                                         **kw)
+            st_in = states[ci]
+            if split_mode and prev_st is not None:
+                # df32: chunks FLOW one (rho_scale, factor) pair through
+                # the sequential loop (the in-jit adaptation keeps its
+                # responsiveness, each chunk inheriting the previous
+                # chunk's adapted stepsize) instead of holding a private
+                # ~0.7 GB factor per chunk — per-chunk copies would
+                # multiply HBM by chunk count x modes at exactly the
+                # scale the split representation exists for. rho is a
+                # stepsize: iterates warm-start across scale changes.
+                st_in = st_in._replace(L=prev_st.L,
+                                       rho_scale=prev_st.rho_scale)
+            st, x, yA, yB = _solver_call(factors, d_c, q_c, st_in, **kw)
+            prev_st = st
+            if split_mode:
+                # record a STRIPPED state: keeping each chunk's L alive
+                # in solved_chunks until pass 3 would pin every
+                # refactorized ~0.7 GB copy simultaneously (the unify
+                # below re-attaches the single flowed factor)
+                st = st._replace(L=jnp.zeros((), jnp.float32))
             solved_chunks.append([st, x, yA, yB, d_c, q_c])
         # pass 2 — bounded recovery: a chunk whose warm-started rho
         # trajectory went pathological (per-chunk shared rho adapts on
@@ -629,6 +657,10 @@ class PHBase(SPBase):
             st2, x2, yA2, yB2 = _solver_call(factors, rec[4], rec[5],
                                              st_r, **kw_r)
             m2 = float(jnp.max(st2.pri_rel))
+            if split_mode:
+                # retry factors are transient too (see the pass-1 strip)
+                st2 = st2._replace(L=jnp.zeros((), jnp.float32))
+                st_r = st_r._replace(L=jnp.zeros((), jnp.float32))
             if np.isfinite(m2) and (is_nan or m2 < m):
                 rec[:4] = [st2, x2, yA2, yB2]
             elif is_nan:
@@ -649,7 +681,6 @@ class PHBase(SPBase):
         # non-shared mode, where qp_setup scales against ITS OWN q).
         # Per-scenario (n, n) factorizations are expensive, so this is
         # capped and only ever runs on the few flagged scenarios.
-        from ..ops.qp_solver import SplitMatrix
         if bool(self.options.get("subproblem_hospital", True)) \
                 and not isinstance(data.A, SplitMatrix):
             # the hospital builds per-scenario (cap, m, n) batched
@@ -695,6 +726,15 @@ class PHBase(SPBase):
                          ("base", base[:real]), ("solved", solved[:real]),
                          ("dual", dual[:real])):
                 parts[k].append(v)
+        if split_mode and prev_st is not None:
+            # UNIFY after the pass: every chunk state adopts the flow's
+            # final (rho_scale, factor) so exactly ONE (n, n) factor
+            # persists between passes (pass 1 strips each record's L
+            # immediately, so at most two factors are ever alive — the
+            # inherited one and, briefly, a refactorized successor)
+            for ci in range(len(states)):
+                states[ci] = states[ci]._replace(
+                    L=prev_st.L, rho_scale=prev_st.rho_scale)
         cat = {k: jnp.concatenate(v) for k, v in parts.items()}
         # lazily concatenated read-only view for the state consumers
         # (assert_feasible_iter0, incumbent feasibility, bench prints);

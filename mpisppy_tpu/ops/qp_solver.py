@@ -1027,10 +1027,17 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     f_lo = _cast_floats(factors_lo_src, lo)
     d_lo = _cast_floats(data_lo_src, lo)
     st_lo = _cast_floats(state, lo)
-    # jitted: the eager path materializes every factorization transient
-    # (the weighted matrix, the product, the factor) as separate
-    # buffers — at big-instance scale that is ~4 GB of avoidable peak
-    st_lo = st_lo._replace(L=_factorize_jit(f_lo, st_lo.rho_scale))
+    if isinstance(factors.A_s, SplitMatrix):
+        # df32 state already carries the f32 Cholesky of THIS M at the
+        # state's rho — recomputing it per solve call would add an
+        # (n, n) factorization (plus its transients) to every chunk
+        # call for an identical result
+        pass
+    else:
+        # jitted: the eager path materializes every factorization
+        # transient (the weighted matrix, the product, the factor) as
+        # separate buffers — at big scale ~4 GB of avoidable peak
+        st_lo = st_lo._replace(L=_factorize_jit(f_lo, st_lo.rho_scale))
     # the f32 phase is a WARM START for the f64 phase: stop it at its
     # noise floor (~1e-3 relative on badly-scaled LPs) — iterating f32
     # past that treads water and, worse, feeds the rho adaptation noise
