@@ -66,10 +66,32 @@ class APHShard(APH):
         nk = sum(N * k for N, k in self._stage_shapes)
         nden = sum(N for N, _ in self._stage_shapes)
         self._nk, self._nden = nk, nden
+        # wheel mode: aph_wheel_S = GLOBAL scenario count enables the
+        # WX gather; shard 0 additionally carries the hub communicator
+        # (set by the wheel launcher). The gather is an ON-DEMAND
+        # reduction (summed once per APH iteration in _wheel_sync) —
+        # riding the listener beat would republish + re-sum 2·S·K
+        # doubles every ~5 ms.
+        self._wheel_S = self.options.get("aph_wheel_S")
+        ondemand = None
+        if self._wheel_S is not None:
+            self._wheel_S = int(self._wheel_S)
+            self._shard_lo = shard_range(self._wheel_S, self.my_shard,
+                                         self.n_shards)[0]
+            K = sum(k for _, k in self._stage_shapes)
+            ondemand = {"WX": 2 * self._wheel_S * K}
+            if int(self.options.get("aph_sync_every", 0)):
+                # the wheel's termination break is asynchronous (shard 0
+                # decides on gap); the periodic barrier's equal-call-
+                # count contract cannot survive it
+                raise ValueError("aph_sync_every cannot be combined "
+                                 "with wheel mode (aph_wheel_S): the "
+                                 "hub's gap termination breaks the "
+                                 "barrier call-count alignment")
         lens = self.reduction_lens(self.batch, self.n_shards)
         self.sync = Synchronizer(
             lens, self.n_shards, self.my_shard, shm_prefix=shm_prefix,
-            windows=windows,
+            windows=windows, ondemand_lens=ondemand,
             sleep_secs=float(self.options.get("listener_sleep_secs", 0.005)))
         self._g = {r: np.zeros(l) for r, l in lens.items()}
         self._l = {r: np.zeros(l) for r, l in lens.items()}
@@ -161,6 +183,33 @@ class APHShard(APH):
             self._g[red][-self.n_shards:] = self.sync.peek_tail(
                 red, self.n_shards)
 
+    # ---- wheel citizenship (spin_aph_shard_wheel) ----
+    def _wheel_sync(self, xn):
+        """Publish this shard's (W, x-nonant) rows into the WX gather;
+        on the hub-carrying shard, stage the gathered FULL arrays and
+        run the cylinder sync. Returns True when the wheel terminated
+        (gap met / spokes satisfied) — a loop-exit for the caller."""
+        if self._wheel_S is None:
+            return False
+        K = self.batch.K
+        off = self._wheel_S * K
+        lo = self._shard_lo * K
+        S_loc = self.batch.S
+        buf = np.zeros(2 * off)
+        buf[lo:lo + S_loc * K] = \
+            np.asarray(self.W, np.float64).reshape(-1)
+        buf[off + lo:off + lo + S_loc * K] = \
+            np.asarray(xn, np.float64).reshape(-1)
+        # on-demand gather (disjoint rows -> the sum is an exact
+        # concat, stale for other shards by at most their publish lag)
+        g = self.sync.reduce_now("WX", buf)
+        if self.spcomm is None:
+            return False
+        self.wheel_W = g[:off].reshape(self._wheel_S, K)
+        self.wheel_X = g[off:].reshape(self._wheel_S, K)
+        self.spcomm.sync()
+        return bool(self.spcomm.is_converged())
+
     # ---- the worker loop (one shard's APH_iterk) ----
     def _work(self):
         warm = getattr(self, "_warm_started", False)
@@ -195,11 +244,16 @@ class APHShard(APH):
             self._z_lag = self.z
         global_toc(f"APHShard[{self.my_shard}] iter 0: trivial bound = "
                    f"{bound:.4f}", self.verbose and self.my_shard == 0)
+        wheel_done = self._wheel_sync(xn0)
+        if wheel_done:
+            global_toc("APHShard wheel: iter-0 termination",
+                       self.verbose and self.my_shard == 0)
 
         nu, gamma = self.nu, self.gamma
         self.conv = np.inf
         it = self._iter = 0
-        while it < self.max_iterations and not self.sync.global_quitting:
+        while not wheel_done and it < self.max_iterations \
+                and not self.sync.global_quitting:
             it += 1
             self._iter = it
             xn = self.nonants_of(self.x)
@@ -298,6 +352,12 @@ class APHShard(APH):
             global_toc(f"APHShard iter {it}: conv={self.conv:.3e} "
                        f"theta={theta:.3e}",
                        self.verbose and self.my_shard == 0 and it % 10 == 0)
+            # wheel sync: gather the full (W, x), push to spokes from
+            # the hub shard, terminate the loop on gap/hub decision
+            if self._wheel_sync(xn):
+                global_toc(f"APHShard wheel: termination at iter {it}",
+                           self.verbose and self.my_shard == 0)
+                break
             # with the periodic barrier on, the convthresh exit is only
             # taken at SYNCED iterations: conv is then rank-identical,
             # so every shard leaves at the same iteration and the
@@ -362,7 +422,11 @@ def make_shard(batch, options, n_shards, my_shard, shm_prefix=None,
 # process, shm/DCN exchange; ref. aph.py:818 APH_main under mpiexec) ----
 
 def _shard_worker(model, num_scens, creator_kwargs, options, n_shards,
-                  my_shard, prefix, q):
+                  my_shard, prefix, q, wheel=None):
+    """``wheel``: optional dict {run_id, spoke_kinds, hub_options} —
+    shard 0 then opens the spoke windows the launcher created and
+    carries an APHShardHub through the APH loop (every shard gets
+    options["aph_wheel_S"] so the WX gather exists group-wide)."""
     import os
 
     try:
@@ -395,8 +459,35 @@ def _shard_worker(model, num_scens, creator_kwargs, options, n_shards,
         batch = build_batch(mod.scenario_creator, subtree(tree, lo, hi),
                             creator_kwargs=creator_kwargs)
         eng = APHShard(batch, options, n_shards, my_shard, shm_prefix=prefix)
-        conv, eobj, triv = eng.run()
-        q.put((my_shard, (conv, eobj, triv, eng._iter)))
+        hub = None
+        if wheel is not None and my_shard == 0:
+            from ..cylinders.hub import APHShardHub
+            from ..utils.multiproc import open_spoke_proxies
+
+            proxies = open_spoke_proxies(wheel["spoke_kinds"],
+                                         wheel["run_id"], num_scens,
+                                         batch.K)
+            hub = APHShardHub(eng, spokes=proxies,
+                              options=wheel.get("hub_options") or {})
+            hub.classify_spokes()
+            hub.windows_made = True
+            hub.setup_hub()
+            eng.spcomm = hub
+        try:
+            conv, eobj, triv = eng.run()
+        finally:
+            if hub is not None:
+                # release the spoke processes whatever happened to the
+                # APH loop (the launcher joins them afterwards)
+                hub.send_terminate()
+        if hub is not None:
+            outer, inner = hub.hub_finalize()
+            for proxy in hub.spokes:
+                proxy.hub_window.close(unlink=False)
+                proxy.my_window.close(unlink=False)
+            q.put((my_shard, (conv, eobj, triv, eng._iter, outer, inner)))
+        else:
+            q.put((my_shard, (conv, eobj, triv, eng._iter)))
     except Exception as e:           # surface, don't hang the parent —
         # construction failures (shm open timeout, spbase validation)
         # must reach the queue too, not just run() failures
@@ -405,7 +496,7 @@ def _shard_worker(model, num_scens, creator_kwargs, options, n_shards,
 
 
 def spin_aph_shards(model: str, num_scens: int, options, n_shards: int,
-                    creator_kwargs=None, join_timeout=600.0):
+                    creator_kwargs=None, join_timeout=600.0, _wheel=None):
     """Spawn one OS process per scenario shard and run APHShard in each.
     Returns shard 0's (conv, Eobjective, trivial_bound, iters). The spawn
     context is used so children initialize JAX cleanly."""
@@ -419,7 +510,8 @@ def spin_aph_shards(model: str, num_scens: int, options, n_shards: int,
     q = ctx.Queue()
     procs = [ctx.Process(target=_shard_worker,
                          args=(model, num_scens, creator_kwargs,
-                               dict(options or {}), n_shards, i, prefix, q),
+                               dict(options or {}), n_shards, i, prefix, q,
+                               _wheel if i == 0 else None),
                          daemon=True)
              for i in range(n_shards)]
     for p in procs:
@@ -450,3 +542,64 @@ def spin_aph_shards(model: str, num_scens: int, options, n_shards: int,
 
         cleanup_shm(prefix)
     return results[0]
+
+
+def spin_aph_shard_wheel(cfg, n_shards: int, join_timeout=600.0,
+                         spoke_ready_timeout=300.0):
+    """The reference's "APH hub + bound spokes under mpiexec" deployment
+    shape (ref. mpisppy/cylinders/hub.py:606 APHHub over rank groups):
+    one OS process per scenario shard running APHShard over the async
+    Synchronizer, PLUS one OS process per spoke cylinder (the same
+    worker utils/multiproc uses), with shard 0 carrying the wheel's hub
+    communicator (cylinders/hub.APHShardHub). ``cfg`` is a RunConfig
+    whose hub is "aph"; returns (conv, Eobjective, trivial_bound,
+    iters, best_outer, best_inner)."""
+    import multiprocessing as mp
+    import os
+    import secrets
+
+    from ..utils.multiproc import spawn_spoke_processes, wait_spoke_hellos
+    from ..ir.batch import build_batch, subtree
+    import importlib
+
+    cfg.validate()
+    mod = importlib.import_module(f"mpisppy_tpu.models.{cfg.model}")
+    # K without lowering the whole batch: lower scenario 0 only
+    probe = build_batch(mod.scenario_creator,
+                        subtree(mod.make_tree(cfg.num_scens), 0, 1),
+                        creator_kwargs=cfg.model_kwargs)
+    S, K = cfg.num_scens, probe.K
+
+    run_id = f"/apw{os.getpid():x}{secrets.token_hex(3)}"
+    ctx = mp.get_context("spawn")
+    owned, spoke_procs = [], []
+    try:
+        proxies, spoke_procs, owned = spawn_spoke_processes(
+            cfg, run_id, ctx, S, K)
+        # wait for every spoke's startup hello so a fast APH run cannot
+        # terminate before the spokes are wired (the parent-side
+        # proxies are only used for this wait; shard 0 opens its own)
+        wait_spoke_hellos(cfg, proxies, spoke_procs, spoke_ready_timeout)
+
+        options = dict(cfg.algo.to_options())
+        options.update(cfg.hub_options)
+        options["aph_wheel_S"] = S
+        hub_options = {}
+        if cfg.rel_gap is not None:
+            hub_options["rel_gap"] = cfg.rel_gap
+        if cfg.abs_gap is not None:
+            hub_options["abs_gap"] = cfg.abs_gap
+        wheel = {"run_id": run_id,
+                 "spoke_kinds": [sp.kind for sp in cfg.spokes],
+                 "hub_options": hub_options}
+        res = spin_aph_shards(cfg.model, S, options, n_shards,
+                              creator_kwargs=cfg.model_kwargs,
+                              join_timeout=join_timeout, _wheel=wheel)
+        return res
+    finally:
+        for p in spoke_procs:
+            p.join(timeout=30.0)
+            if p.is_alive():
+                p.terminate()
+        for w in owned:
+            w.close(unlink=True)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.qp_solver import (qp_solve_segmented, qp_objective,
-                             _Ax, host_dense_A)
+                             _Ax, host_dense_A, support_touch)
 
 
 def _dive_once(factors, data, q, state, imask, round_offset,
@@ -228,12 +228,10 @@ def dive_integers(factors, data, q, c0, state, integer_mask,
         tol_row = feas_tol * (1.0 + np.maximum(l_fin, u_fin))
         viol = (Ax < np.where(np.isfinite(l_h), l_h, -np.inf) - tol_row) \
             | (Ax > np.where(np.isfinite(u_h), u_h, np.inf) + tol_row)
-        A_h = host_dense_A(data.A)
-        supp = (np.abs(A_h) > 1e-10)
-        if supp.ndim == 2:
-            touch = viol.astype(float) @ supp          # (S, n)
-        else:
-            touch = np.einsum("sm,smn->sn", viol.astype(float), supp)
+        # column-touch through A's support, computed ON DEVICE: the big
+        # representations (SplitMatrix / ScaledView) must not be pulled
+        # dense to host (GB-scale d2h on tunneled links)
+        touch = np.asarray(support_touch(data.A, viol))
         bad = ~np.asarray(feasible)
         unpin = (touch > 0.5) & np.asarray(imask) & bad[:, None]
         lb2, ub2 = lb.copy(), ub.copy()

@@ -67,7 +67,12 @@ def _ph_assemble(data, c, W, xbar, rho, idx, fixed_mask, fixed_vals,
         jnp.where(fixed_mask, fixed_vals, data.lb[:, idx]))
     bu = data.ub.at[:, idx].set(
         jnp.where(fixed_mask, fixed_vals, data.ub[:, idx]))
-    return q, data._replace(lb=bl, ub=bu)
+    # return VECTORS only — the caller re-attaches them to its QPData
+    # eagerly. Returning data._replace(...) from this jit would pass
+    # the (possibly multi-GB) constraint matrix through the jit
+    # boundary, which XLA COPIES per call (measured +2.7 GB per chunk
+    # at reference-UC scale).
+    return q, bl, bu
 
 
 @partial(jax.jit, static_argnames=("w_on", "slot_slices"))
@@ -189,8 +194,10 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
     costs) is an ARGUMENT, not a closure constant: closing over batch
     tensors would bake them into the lowered program as literals
     (gigabytes at UC scale)."""
-    q, d = _ph_assemble(data, c, W, xbar, rho, idx, fixed_mask, fixed_vals,
-                        wscale, w_on=w_on, prox_on=prox_on)
+    q, bl, bu = _ph_assemble(data, c, W, xbar, rho, idx, fixed_mask,
+                             fixed_vals, wscale, w_on=w_on,
+                             prox_on=prox_on)
+    d = data._replace(lb=bl, ub=bu)
     qp_state, x, yA, yB = _solver_call(
         factors, d, q, qp_state, prox_on=prox_on, precision=precision,
         sub_max_iter=sub_max_iter, sub_eps=sub_eps,
@@ -346,8 +353,8 @@ class PHBase(SPBase):
                     jnp.asarray(rho_np[0], self.dtype))
                 return d._replace(P_diag=P)
             # per-scenario rho: fall back to the batched representation
-            from ..ops.qp_solver import SplitMatrix
-            if isinstance(d.A, SplitMatrix):
+            from ..ops.qp_solver import ScaledView, SplitMatrix
+            if isinstance(d.A, (SplitMatrix, ScaledView)):
                 raise ValueError(
                     "per-scenario rho needs the batched (S, m, n) "
                     "representation, which the df32 SplitMatrix cannot "
@@ -372,7 +379,8 @@ class PHBase(SPBase):
         so one factorization serves every candidate x̂."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._factors:
-            from ..ops.qp_solver import SplitMatrix, qp_setup_like
+            from ..ops.qp_solver import (ScaledView, SplitMatrix,
+                                         qp_setup_like)
             d = self._data_with_prox(prox_on)
             d_setup = d
             if fixed:
@@ -382,7 +390,8 @@ class PHBase(SPBase):
                 idx = self.nonant_idx
                 d_setup = d._replace(lb=d.lb.at[:, idx].set(0.0),
                                      ub=d.ub.at[:, idx].set(0.0))
-            is_split = isinstance(self.qp_data.A, SplitMatrix)
+            is_split = isinstance(self.qp_data.A,
+                                  (SplitMatrix, ScaledView))
             base = next((f for f, _ in self._factors.values()), None)
             if base is not None and isinstance(base.A_s, SplitMatrix):
                 # df32: every mode shares ONE equilibration + scaled
@@ -413,9 +422,25 @@ class PHBase(SPBase):
                     else:
                         fac = qp_setup(d_setup, q_ref=self.c)
                         cache[bkey] = fac
+                        # the raw split A and the scaled split cannot
+                        # BOTH stay in HBM at the scale df32 exists for
+                        # (2.7 GB each on reference UC): from here on,
+                        # every consumer reads A through the scaled
+                        # view and the raw pair frees once the last
+                        # engine's qp_data drops it
+                        cache[("A", str(self.dtype), True)] = ScaledView(
+                            fac.A_s, fac.D, fac.E)
             else:
                 # mesh df32 engines (or non-split) build their own
                 fac = qp_setup(d_setup, q_ref=self.c)
+            if is_split and isinstance(fac.A_s, SplitMatrix) \
+                    and isinstance(self.qp_data.A, SplitMatrix):
+                # swap this engine's raw split A for the scaled view
+                # (see the cache note above); d rides along so the
+                # solver's data matches
+                view = ScaledView(fac.A_s, fac.D, fac.E)
+                self.qp_data = self.qp_data._replace(A=view)
+                d = d._replace(A=view)
             self._factors[key] = (fac, d)
         return self._factors[key]
 
@@ -566,12 +591,12 @@ class PHBase(SPBase):
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
                                 lb=data.lb[idx_c], ub=data.ub[idx_c])
             ws = None if self._w_scale is None else self._w_scale[idx_c]
-            q_c, d_c = _ph_assemble(d_c, self.c[idx_c], self.W[idx_c],
-                                    self.xbar[idx_c], self.rho[idx_c],
-                                    self.nonant_idx,
-                                    self._fixed_mask[idx_c],
-                                    self._fixed_vals[idx_c], ws,
-                                    w_on=bool(w_on), prox_on=bool(prox_on))
+            q_c, bl_c, bu_c = _ph_assemble(
+                d_c, self.c[idx_c], self.W[idx_c], self.xbar[idx_c],
+                self.rho[idx_c], self.nonant_idx,
+                self._fixed_mask[idx_c], self._fixed_vals[idx_c], ws,
+                w_on=bool(w_on), prox_on=bool(prox_on))
+            d_c = d_c._replace(lb=bl_c, ub=bu_c)
             st_in = states[ci]
             if split_mode and prev_st is not None:
                 # df32: chunks FLOW one (rho_scale, factor) pair through
@@ -681,8 +706,9 @@ class PHBase(SPBase):
         # non-shared mode, where qp_setup scales against ITS OWN q).
         # Per-scenario (n, n) factorizations are expensive, so this is
         # capped and only ever runs on the few flagged scenarios.
+        from ..ops.qp_solver import ScaledView
         if bool(self.options.get("subproblem_hospital", True)) \
-                and not isinstance(data.A, SplitMatrix):
+                and not isinstance(data.A, (SplitMatrix, ScaledView)):
             # the hospital builds per-scenario (cap, m, n) batched
             # factors — structurally impossible at the scale df32
             # exists for (one (n, n) f64 host inversion there costs
@@ -805,11 +831,11 @@ class PHBase(SPBase):
         d_h = QPData(P_b, A_b, data.l[sel_p], data.u[sel_p],
                      data.lb[sel_p], data.ub[sel_p])
         ws = None if self._w_scale is None else self._w_scale[sel_p]
-        q_h, d_h = _ph_assemble(d_h, self.c[sel_p], self.W[sel_p],
-                                self.xbar[sel_p], self.rho[sel_p],
-                                self.nonant_idx, self._fixed_mask[sel_p],
-                                self._fixed_vals[sel_p], ws,
-                                w_on=w_on, prox_on=prox_on)
+        q_h, bl_h, bu_h = _ph_assemble(
+            d_h, self.c[sel_p], self.W[sel_p], self.xbar[sel_p],
+            self.rho[sel_p], self.nonant_idx, self._fixed_mask[sel_p],
+            self._fixed_vals[sel_p], ws, w_on=w_on, prox_on=prox_on)
+        d_h = d_h._replace(lb=bl_h, ub=bu_h)
         fac_h = qp_setup(d_h, q_ref=q_h)
         st_h = qp_cold_state(fac_h, d_h)
         # pass 1's kwargs with precision/budget escalated and LONG

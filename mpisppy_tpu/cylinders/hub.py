@@ -170,8 +170,17 @@ class PHHub(Hub):
     def setup_hub(self):
         assert self.windows_made
 
-    def send_ws(self, X=None):
-        W = np.asarray(self.opt.W, dtype=np.float64).reshape(-1)
+    def _hub_arrays(self):
+        """(W_flat, X_flat) the spokes should see — the ONE overridable
+        source (APHShardHub substitutes Synchronizer-gathered full
+        arrays; the push layout below stays shared)."""
+        return (np.asarray(self.opt.W, dtype=np.float64).reshape(-1),
+                np.asarray(self.opt._hub_nonants(),
+                           np.float64).reshape(-1))
+
+    def send_ws(self, X=None, W=None):
+        if W is None:
+            W = self._hub_arrays()[0]
         for i in self.w_spoke_indices:
             sp = self.spokes[i]
             has_w, has_x = sp.hub_read_layout()
@@ -183,8 +192,8 @@ class PHHub(Hub):
 
     def sync(self):
         """Called from inside the PH iteration (ref. phbase.py:1522)."""
-        X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
-        self.send_ws(X)
+        W, X = self._hub_arrays()
+        self.send_ws(X, W=W)
         self.send_nonants(X)
         self.receive_bounds()
 
@@ -235,6 +244,27 @@ class APHHub(PHHub):
 
     def main(self):
         self.opt.APH_main(finalize=False)
+
+
+class APHShardHub(PHHub):
+    """Wheel communicator carried by SHARD 0 of a scenario-sharded APH
+    (core/aph_shard.py spin_aph_shard_wheel) — the analog of the
+    reference's APHHub under mpiexec (ref. mpisppy/cylinders/hub.py:606
+    APHHub), where hub ranks hold scenario subsets and the cylinder
+    windows carry global arrays. The shard engine holds only its local
+    scenarios; the FULL (W, nonant) block arrives through the async
+    Synchronizer's "WX" reduction (disjoint per-shard rows, so the sum
+    is an exact gather, stale for other shards by at most a listener
+    beat — the same tolerated staleness as every APH reduction) and is
+    staged on the engine as ``wheel_W`` / ``wheel_X`` before sync()."""
+
+    def _hub_arrays(self):
+        return (np.asarray(self.opt.wheel_W, np.float64).reshape(-1),
+                np.asarray(self.opt.wheel_X, np.float64).reshape(-1))
+
+    def main(self):
+        raise RuntimeError("APHShardHub is driven by the shard worker's "
+                           "APH loop (core/aph_shard.py), not main()")
 
 
 class LShapedHub(Hub):

@@ -123,11 +123,66 @@ def merged64(A):
     return A.astype(jnp.float64) if hasattr(A, "astype") else A
 
 
+class ScaledView(NamedTuple):
+    """QPData.A as a VIEW over the factors' scaled matrix:
+    A = diag(1/E) · A_s · diag(1/D). At df32 scale the raw split A and
+    the scaled split A_s cannot both live in HBM (2.7 GB each on the
+    reference-UC instance); once the base factors exist, engines swap
+    their QPData.A for this view and the raw pair frees. Matvec
+    consumers (_Ax/_ATy — residual checks, dual objectives, dives)
+    dispatch on it transparently."""
+    A_s: jax.Array          # SplitMatrix or dense (m, n)
+    D: jax.Array            # (n,)
+    E: jax.Array            # (m,)
+
+    @property
+    def ndim(self):
+        return 2
+
+    @property
+    def shape(self):
+        return self.A_s.shape
+
+    @property
+    def dtype(self):
+        return jnp.float64
+
+
 def host_dense_A(A):
-    """Host numpy f64 of a QPData.A under any representation."""
+    """Host numpy f64 of a QPData.A under any representation. A
+    ScaledView at df32 scale would need a multi-GB device->host pull
+    (~minutes on tunneled links) — consumers must use the device
+    dispatch paths instead."""
+    if isinstance(A, ScaledView):
+        raise TypeError("host_dense_A on a ScaledView: reconstructing "
+                        "the dense matrix host-side defeats the view's "
+                        "purpose; use _Ax/_ATy/support_touch on device")
     if isinstance(A, SplitMatrix):
         return np.asarray(A.hi, np.float64) + np.asarray(A.lo, np.float64)
     return np.asarray(A, np.float64)
+
+
+@jax.jit
+def _support_touch_jit(hi, viol):
+    # jitted so the abs/mask/cast fuse into the matmul operand instead
+    # of materializing eager (m, n) transients (GBs at df32 scale)
+    supp = (jnp.abs(hi) > 1e-10).astype(jnp.float32)
+    v = viol.astype(jnp.float32)
+    if hi.ndim == 2:
+        return v @ supp
+    return jnp.einsum("sm,smn->sn", v, supp)
+
+
+def support_touch(A, viol):
+    """(S, n) column-touch counts of the (S, m) bool row mask ``viol``
+    through A's sparsity support — on DEVICE for the big
+    representations (the dive's targeted-repair column selection)."""
+    hi = A
+    if isinstance(A, ScaledView):
+        hi = A.A_s
+    if isinstance(hi, SplitMatrix):
+        hi = hi.hi
+    return _support_touch_jit(hi, jnp.asarray(viol))
 
 
 class QPData(NamedTuple):
@@ -174,9 +229,11 @@ class QPState(NamedTuple):
 
 
 def _Ax(A, x):
-    """A x with A (m,n) shared, (S,m,n) batched, or SplitMatrix (df32);
-    x (S,n) -> (S,m). The split path runs three f32 MXU passes and
-    accumulates in f64 (see SplitMatrix)."""
+    """A x with A (m,n) shared, (S,m,n) batched, SplitMatrix (df32), or
+    ScaledView; x (S,n) -> (S,m). The split path runs three f32 MXU
+    passes and accumulates in f64 (see SplitMatrix)."""
+    if isinstance(A, ScaledView):
+        return _Ax(A.A_s, x / A.D) / A.E
     if isinstance(A, SplitMatrix):
         xh = x.astype(jnp.float32)
         xl = (x - xh.astype(jnp.float64)).astype(jnp.float32)
@@ -189,8 +246,10 @@ def _Ax(A, x):
 
 
 def _ATy(A, y):
-    """Aᵀ y with A (m,n) shared, (S,m,n) batched, or SplitMatrix;
-    y (S,m) -> (S,n)."""
+    """Aᵀ y with A (m,n) shared, (S,m,n) batched, SplitMatrix, or
+    ScaledView; y (S,m) -> (S,n)."""
+    if isinstance(A, ScaledView):
+        return _ATy(A.A_s, y / A.E) / A.D
     if isinstance(A, SplitMatrix):
         yh = y.astype(jnp.float32)
         yl = (y - yh.astype(jnp.float64)).astype(jnp.float32)
@@ -405,11 +464,15 @@ def _chol_solve(F, b):
 
 
 @partial(jax.jit, static_argnames=("eq_boost", "shared"))
-def _setup_from_scaled(data: QPData, A_s, D, E, Eb, q_ref, rho_base, sigma,
-                       eq_boost, shared):
+def _setup_vectors(P_diag, l, u, lb, ub, D, q_ref, rho_base, eq_boost,
+                   shared):
     """Everything in qp_setup AFTER the scaled matrix exists: cost
-    normalization + equality-boost rho patterns (vector math only)."""
-    P_diag, _, l, u, lb, ub = data
+    normalization + equality-boost rho patterns (vector math only).
+    Deliberately takes/returns NO matrix: a jit that passes a matrix
+    through to its output makes XLA COPY it per call — measured
+    +2.7 GB per invocation at reference-UC scale. Returns
+    (P_s, cost_scale, rho_A, rho_b); callers attach the matrix
+    eagerly."""
     dt = D.dtype
     P_s = D * P_diag * D
     # cost normalization (OSQP sec 5.1): scale so the objective gradient is O(1)
@@ -441,18 +504,22 @@ def _setup_from_scaled(data: QPData, A_s, D, E, Eb, q_ref, rho_base, sigma,
         is_eq_b = jnp.all(is_eq_b, axis=0)
     rho_A = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
     rho_b = jnp.where(is_eq_b, rho_base * eq_boost, rho_base).astype(dt)
-    return QPFactors(sigma=jnp.asarray(sigma, dt), D=D, E=E, Eb=Eb,
-                     cost_scale=cost_scale, A_s=A_s, P_s=P_s,
-                     rho_A=rho_A, rho_b=rho_b)
+    return P_s, cost_scale, rho_A, rho_b
 
 
 @partial(jax.jit, static_argnames=("eq_boost",))
 def _qp_setup_dense(data: QPData, q_ref, rho_base, sigma, eq_boost):
-    P_diag, A, *_ = data
+    # one jit: A_s is CREATED inside, so returning it costs nothing
+    # extra (unlike pass-through returns — see _setup_vectors)
+    P_diag, A, l, u, lb, ub = data
     D, E, Eb = _ruiz_equilibrate(P_diag, A)
     A_s = E[..., :, None] * A * D[..., None, :]
-    return _setup_from_scaled(data, A_s, D, E, Eb, q_ref, rho_base, sigma,
-                              eq_boost, A.ndim == 2)
+    dt = A.dtype
+    P_s, cost_scale, rho_A, rho_b = _setup_vectors(
+        P_diag, l, u, lb, ub, D, q_ref, rho_base, eq_boost, A.ndim == 2)
+    return QPFactors(sigma=jnp.asarray(sigma, dt), D=D, E=E, Eb=Eb,
+                     cost_scale=cost_scale, A_s=A_s, P_s=P_s,
+                     rho_A=rho_A, rho_b=rho_b)
 
 
 @partial(jax.jit, static_argnames=("nblocks",))
@@ -478,15 +545,21 @@ def _scale_split_blocks(A: SplitMatrix, D, E, nblocks=8) -> SplitMatrix:
 def _qp_setup_split(data: QPData, q_ref, rho_base, sigma, eq_boost):
     """df32 setup: Ruiz on the f32 hi part (D/E/Eb are heuristic
     scalings — a 1e-7-relative view of |A| changes nothing), scaled
-    split built blockwise, vector tail shared with the dense path."""
+    split built blockwise, vector tail shared with the dense path. The
+    QPFactors tuple is assembled EAGERLY so A_s never passes through a
+    jit boundary (see _setup_vectors)."""
     A = data.A
     f64 = jnp.float64
     D32, E32, Eb32 = _ruiz_equilibrate(data.P_diag.astype(jnp.float32),
                                        A.hi)
     D, E, Eb = D32.astype(f64), E32.astype(f64), Eb32.astype(f64)
     A_s = _scale_split_blocks(A, D, E)
-    return _setup_from_scaled(data, A_s, D, E, Eb, q_ref, rho_base,
-                              sigma, eq_boost, True)
+    P_s, cost_scale, rho_A, rho_b = _setup_vectors(
+        data.P_diag, data.l, data.u, data.lb, data.ub, D, q_ref,
+        rho_base, eq_boost, True)
+    return QPFactors(sigma=jnp.asarray(sigma, f64), D=D, E=E, Eb=Eb,
+                     cost_scale=cost_scale, A_s=A_s, P_s=P_s,
+                     rho_A=rho_A, rho_b=rho_b)
 
 
 def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6,
@@ -500,21 +573,11 @@ def qp_setup(data: QPData, q_ref=None, rho_base=0.1, sigma=1e-6,
     return _qp_setup_dense(data, q_ref, rho_base, sigma, eq_boost)
 
 
-@partial(jax.jit, static_argnames=("eq_boost",))
-def qp_setup_like(base: QPFactors, data: QPData, rho_base=0.1,
-                  eq_boost=1e3):
-    """Factors for a RELATED mode (prox on/off, pinned boxes) REUSING
-    ``base``'s equilibration and scaled matrix: only the scaled
-    quadratic diagonal and the rho boost patterns are recomputed
-    (vector math). The Ruiz scalings are heuristic — a mode whose P
-    differs on a diagonal block is equally well served by the base
-    mode's D/E — while a per-mode re-setup would duplicate the scaled
-    (m, n) matrix per mode, which at big-instance (df32) scale is
-    gigabytes of HBM per mode (the reason this exists)."""
-    P_diag, _, l, u, lb, ub = data
-    shared = base.A_s.ndim == 2
-    csx = base.cost_scale if shared else base.cost_scale[:, None]
-    P_s = base.D * P_diag * base.D * csx
+@partial(jax.jit, static_argnames=("eq_boost", "shared"))
+def _setup_like_vectors(P_diag, l, u, lb, ub, D, cost_scale, rho_base,
+                        eq_boost, shared):
+    csx = cost_scale if shared else cost_scale[:, None]
+    P_s = D * P_diag * D * csx
 
     def _is_eq(lo, hi):
         d_ = hi - lo
@@ -526,9 +589,28 @@ def qp_setup_like(base: QPFactors, data: QPData, rho_base=0.1,
     if shared:
         is_eq = jnp.all(is_eq, axis=0)
         is_eq_b = jnp.all(is_eq_b, axis=0)
-    dt = base.D.dtype
+    dt = D.dtype
     rho_A = jnp.where(is_eq, rho_base * eq_boost, rho_base).astype(dt)
     rho_b = jnp.where(is_eq_b, rho_base * eq_boost, rho_base).astype(dt)
+    return P_s, rho_A, rho_b
+
+
+def qp_setup_like(base: QPFactors, data: QPData, rho_base=0.1,
+                  eq_boost=1e3):
+    """Factors for a RELATED mode (prox on/off, pinned boxes) REUSING
+    ``base``'s equilibration and scaled matrix: only the scaled
+    quadratic diagonal and the rho boost patterns are recomputed
+    (vector math, jitted). The _replace happens EAGERLY — running it
+    inside a jit would pass the multi-GB A_s through the jit boundary,
+    which XLA copies per call (measured +2.7 GB per mode at
+    reference-UC scale, the exact duplication this function exists to
+    avoid). The Ruiz scalings are heuristic — a mode whose P differs
+    on a diagonal block is equally well served by the base mode's
+    D/E."""
+    shared = base.A_s.ndim == 2
+    P_s, rho_A, rho_b = _setup_like_vectors(
+        data.P_diag, data.l, data.u, data.lb, data.ub, base.D,
+        base.cost_scale, rho_base, eq_boost, shared)
     return base._replace(P_s=P_s, rho_A=rho_A, rho_b=rho_b)
 
 

@@ -86,6 +86,14 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
                    "cuda": "CUDA_VISIBLE_DEVICES"}.get(platform)
         if env_key:
             os.environ[env_key] = str(vis)
+    # the env var alone is NOT enough: jax binds jax_platforms from the
+    # environment at import time, and the spawn machinery imports jax
+    # (module-level jax.numpy imports in the pickled call graph) before
+    # this worker body runs — under a tunneled-TPU parent the child
+    # would silently fight the hub for the single-process device link
+    import jax
+
+    jax.config.update("jax_platforms", platform)
     from .runtime import setup_jax_runtime
 
     setup_jax_runtime(f32)
@@ -116,6 +124,73 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
         spoke.my_window.close(unlink=False)
 
 
+def _spoke_window_names(run_id, i):
+    """THE window naming scheme (creator and opener must agree)."""
+    return f"{run_id}h{i}", f"{run_id}s{i}"
+
+
+def _spoke_proxy(kind, run_id, i, S, K, create):
+    """One spoke's proxy with its window pair, on either side of the
+    shm handshake (create=True: wheel launcher; False: a consumer in
+    another process, e.g. the sharded-APH hub shard)."""
+    from .vanilla import spoke_classes
+
+    spoke_cls, _ = spoke_classes(kind)
+    hub_name, my_name = _spoke_window_names(run_id, i)
+    proxy = SpokeProxy(spoke_cls, S, K, None, None)
+    proxy.hub_window = Window.shared(
+        hub_name, proxy.remote_window_length(), create=create)
+    proxy.my_window = Window.shared(
+        my_name, proxy.local_window_length(), create=create)
+    return proxy
+
+
+def open_spoke_proxies(spoke_kinds, run_id, S, K):
+    """Open (create=False) the window pairs spawn_spoke_processes
+    created — the consumer side of the ONE naming scheme."""
+    return [_spoke_proxy(kind, run_id, i, S, K, create=False)
+            for i, kind in enumerate(spoke_kinds)]
+
+
+def spawn_spoke_processes(cfg: RunConfig, run_id, ctx, S, K, f32=False):
+    """Create the window pair + worker process for every spoke in
+    ``cfg`` (window names ``{run_id}h{i}`` / ``{run_id}s{i}`` — the ONE
+    naming scheme; spin_the_wheel_processes and the sharded-APH wheel
+    launcher both spawn through here). Returns (proxies, procs,
+    owned_windows); the caller owns window unlink and process joins."""
+    from dataclasses import asdict
+
+    proxies, procs, owned = [], [], []
+    for i, sp in enumerate(cfg.spokes):
+        proxy = _spoke_proxy(sp.kind, run_id, i, S, K, create=True)
+        owned += [proxy.hub_window, proxy.my_window]
+        proxies.append(proxy)
+        p = ctx.Process(target=_spoke_worker,
+                        args=(cfg.to_dict(), asdict(sp),
+                              *_spoke_window_names(run_id, i), f32),
+                        daemon=True)
+        p.start()
+        procs.append(p)
+    return proxies, procs, owned
+
+
+def wait_spoke_hellos(cfg: RunConfig, proxies, procs, timeout):
+    """Block until every spoke's startup hello lands (so gap-based
+    termination cannot fire before cold-starting spoke processes have
+    joined the wheel)."""
+    deadline = time.monotonic() + timeout
+    for i, proxy in enumerate(proxies):
+        while proxy.my_window.read_id() == 0:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"spoke {cfg.spokes[i].kind} (pid {procs[i].pid}) "
+                    "never sent its startup hello")
+            if not procs[i].is_alive():
+                raise RuntimeError(
+                    f"spoke {cfg.spokes[i].kind} died during startup")
+            time.sleep(0.05)
+
+
 def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
                              spoke_ready_timeout=300.0):
     """One hub (this process) + one OS process per spoke. Returns the hub
@@ -129,7 +204,7 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
     cleanly (a forked JAX runtime is unsupported)."""
     cfg.validate()
 
-    from .vanilla import hub_dict, spoke_classes
+    from .vanilla import hub_dict
 
     hub_d = hub_dict(cfg)
     hub_opt = hub_d["opt_class"](**hub_d["opt_kwargs"])
@@ -139,40 +214,14 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
     ctx = mp.get_context("spawn")
     proxies, procs, owned = [], [], []
     try:
-        for i, sp in enumerate(cfg.spokes):
-            spoke_cls, _ = spoke_classes(sp.kind)
-            hub_name = f"{run_id}h{i}"
-            my_name = f"{run_id}s{i}"
-            proxy = SpokeProxy(spoke_cls, S, K, None, None)
-            proxy.hub_window = Window.shared(
-                hub_name, proxy.remote_window_length(), create=True)
-            proxy.my_window = Window.shared(
-                my_name, proxy.local_window_length(), create=True)
-            owned += [proxy.hub_window, proxy.my_window]
-            proxies.append(proxy)
-            from dataclasses import asdict
-            p = ctx.Process(target=_spoke_worker,
-                            args=(cfg.to_dict(), asdict(sp), hub_name,
-                                  my_name, f32), daemon=True)
-            p.start()
-            procs.append(p)
-
+        proxies, procs, owned = spawn_spoke_processes(cfg, run_id, ctx,
+                                                      S, K, f32)
         hub = hub_d["hub_class"](hub_opt, spokes=proxies,
                                  **hub_d.get("hub_kwargs", {}))
         hub.classify_spokes()
         hub.windows_made = True
         hub.setup_hub()
-        deadline = time.monotonic() + spoke_ready_timeout
-        for i, proxy in enumerate(proxies):
-            while proxy.my_window.read_id() == 0:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"spoke {cfg.spokes[i].kind} (pid {procs[i].pid}) "
-                        "never sent its startup hello")
-                if not procs[i].is_alive():
-                    raise RuntimeError(
-                        f"spoke {cfg.spokes[i].kind} died during startup")
-                time.sleep(0.05)
+        wait_spoke_hellos(cfg, proxies, procs, spoke_ready_timeout)
         try:
             hub.main()
         finally:

@@ -88,9 +88,16 @@ class Synchronizer:
 
     def __init__(self, names_lens, n_participants, my_index, shm_prefix=None,
                  windows=None, sleep_secs=0.01, listener_gigs=None,
-                 open_timeout=60.0):
+                 open_timeout=60.0, ondemand_lens=None):
         self.names_lens = dict(names_lens)
+        # on-demand reductions: windows exist, but the LISTENER never
+        # touches them — they are summed only when a worker calls
+        # reduce_now. For big once-per-iteration payloads (the sharded
+        # wheel's full-(W, x) gather: 2·S·K doubles) that would
+        # otherwise be republished and re-summed on every ~5 ms beat.
+        self.ondemand_lens = dict(ondemand_lens or {})
         assert _CTRL not in self.names_lens
+        assert not set(self.ondemand_lens) & set(self.names_lens)
         self.n = int(n_participants)
         self.me = int(my_index)
         self.sleep_secs = float(sleep_secs)
@@ -105,6 +112,7 @@ class Synchronizer:
         self._listener = None
 
         lens = _augment_lens(self.names_lens)
+        lens.update(self.ondemand_lens)
         self._sync_round = 0
         if windows is not None:
             self._windows = windows
@@ -204,6 +212,20 @@ class Synchronizer:
             if self.enable_side_gig:
                 raise RuntimeError("side gig already enabled")
             self.enable_side_gig = True
+
+    def reduce_now(self, redname, local_vec):
+        """One wait-free sum of an ON-DEMAND reduction (see
+        ondemand_lens): publish my summand, read every peer's latest,
+        return the sum. Same staleness semantics as the listener
+        reductions — a slow peer contributes its last published vector
+        — at zero listener-beat cost."""
+        row = self._windows[redname]
+        row[self.me].put(np.asarray(local_vec, dtype=np.float64))
+        total = np.zeros(row[self.me].length)
+        for p in range(self.n):
+            vals, _ = row[p].read()
+            total += vals
+        return total
 
     def get_global_data(self, global_out):
         with self.data_lock:

@@ -241,3 +241,41 @@ def test_aphshard_processes_farmer():
     assert triv <= EF3 + 1.0
     assert np.isfinite(eobj)
     assert iters >= 1
+
+
+def test_aph_shard_wheel_farmer():
+    """The reference's 'APH hub + bound spokes under mpiexec' shape
+    (ref. mpisppy/cylinders/hub.py:606 APHHub): scenario-sharded APH
+    processes over the async Synchronizer, shard 0 carrying the wheel
+    hub, plus Lagrangian and xhatshuffle spoke PROCESSES — bounds must
+    sandwich the EF optimum (VERDICT r3 #7)."""
+    from mpisppy_tpu.core.aph_shard import spin_aph_shard_wheel
+    from mpisppy_tpu.utils.config import AlgoConfig, RunConfig, SpokeConfig
+
+    cfg = RunConfig(
+        model="farmer", num_scens=4, hub="aph",
+        # enough hub iterations that the spoke PROCESSES (cold JAX
+        # init + first compile each) land their first bounds before the
+        # APH loop runs out; the rel_gap exit ends the wheel early once
+        # both bounds arrive
+        algo=AlgoConfig(default_rho=10.0, max_iterations=800,
+                        convthresh=-1.0, subproblem_max_iter=3000,
+                        subproblem_eps=1e-8),
+        spokes=[SpokeConfig(kind="lagrangian"),
+                SpokeConfig(kind="xhatshuffle")],
+        rel_gap=0.05)
+    conv, eobj, triv, iters, outer, inner = spin_aph_shard_wheel(
+        cfg, n_shards=2)
+    # 4-scenario farmer EF sits between the published bounds
+    from mpisppy_tpu.core.ef import ExtensiveForm
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.models import farmer
+
+    ef_obj, _ = ExtensiveForm(
+        build_batch(farmer.scenario_creator,
+                    farmer.make_tree(4))).solve_extensive_form()
+    assert np.isfinite(outer), "lagrangian spoke never published a bound"
+    assert np.isfinite(inner), "xhat spoke never published an incumbent"
+    assert outer <= ef_obj + 1e-4 * abs(ef_obj)
+    assert inner >= ef_obj - 1e-4 * abs(ef_obj)
+    assert triv <= ef_obj + 1.0
