@@ -87,14 +87,25 @@ INSTANCE_STR = ("90 gens x 48 h, min-up/down + ramping ON, "
 DF32 = {
     "subproblem_precision": "df32",
     "defaultPHrho": 100.0,
-    "subproblem_max_iter": 1500,
+    # budgets sized from the measured per-iteration cost at this scale
+    # (~12 ms f32 / ~45 ms df32-tail per 128-chunk iteration): the
+    # first dry run at 1500+500 spent 427 s/PH-iter at S=1024 with the
+    # solves burning full budget down to pri_rel 9e-4 — PH needs loose
+    # hot solves + warm starts, not per-iteration perfection (the r3
+    # architecture; certified bounds come from prox-off/host paths)
+    "subproblem_max_iter": 600,
     "subproblem_eps": 1e-5,
     "subproblem_eps_hot": 1e-4,
     "subproblem_eps_dua_hot": 1e-2,
-    "subproblem_stall_rel": 1e-4,
-    "subproblem_tail_iter": 500,
-    "subproblem_segment": 250,
-    "subproblem_segment_lo": 1500,
+    # the stall gate must sit ABOVE the df32 residual floor (~5e-4 on
+    # this instance) or plateaued solves burn their whole budget
+    # (measured: 0.6x throughput with a 1e-4 gate, every hot solve at
+    # max_iter; the achieved quality is printed with the metric either
+    # way)
+    "subproblem_stall_rel": 1.5e-3,
+    "subproblem_tail_iter": 200,
+    "subproblem_segment": 200,
+    "subproblem_segment_lo": 600,
     "subproblem_polish_hot": False,
     "subproblem_hospital": False,
     "display_timing": True,
@@ -134,6 +145,19 @@ def big_batch(S):
         shard.tree.probabilities[:] = prob
         _BATCH_CACHE[S] = replace(shard, prob=prob)
     return _BATCH_CACHE[S]
+
+
+def _release_device(S):
+    """Drop a batch size's device-side cache (scatter-built A, scaled
+    split, factors). Metrics at different S must not pin each other's
+    multi-GB device arrays — the host batch stays cached, so a later
+    metric at the same S only re-pays device setup (~1 min), not the
+    template lowering."""
+    full = _BATCH_CACHE.get("full")
+    key = "full" if (full is not None and S == full.S) else S
+    b = _BATCH_CACHE.get(key)
+    if b is not None and getattr(b, "_dev_cache", None):
+        b._dev_cache.clear()
 
 
 def _flops_per_admm_iter(chunk):
@@ -192,6 +216,7 @@ def bench_throughput():
         "vs_baseline": round(solves_per_sec / baseline, 2),
     }), flush=True)
     del ph
+    _release_device(128)
 
 
 def bench_1024():
@@ -286,7 +311,7 @@ def _wheel(S, hub_extra=None, lag_extra=None, xhat_extra=None,
     return hub_dict, spoke_dicts
 
 
-def _warm_gap_programs(S):
+def _warm_gap_programs(S, dive=True):
     """Compile every device program a gap wheel will use BEFORE the
     timed window: hub iter0/hot modes, the commitment dive, and the
     fixed-nonant incumbent evaluation. The warmup engine shares the
@@ -296,7 +321,13 @@ def _warm_gap_programs(S):
 
     batch = big_batch(S)
     chunk_kw = {"subproblem_chunk": 128} if S > 128 else {}
-    ph = PHBase(batch, dict(DF32, iter0_feas_tol=5e-3, **chunk_kw),
+    # REDUCED budgets: this engine exists to trigger compiles (and at
+    # S=1024, bench_1024 already compiled the solve programs — only
+    # the dive/incumbent programs are new); segment sizes match DF32 so
+    # every program is the cached one
+    ph = PHBase(batch, dict(DF32, iter0_feas_tol=5e-3,
+                            subproblem_max_iter=200,
+                            subproblem_tail_iter=100, **chunk_kw),
                 dtype=jax.numpy.float64)
     _progress(f"gap warmup S={S}: iter0")
     ph.solve_loop(w_on=False, prox_on=False)
@@ -304,25 +335,29 @@ def _warm_gap_programs(S):
     _progress(f"gap warmup S={S}: hot")
     ph.solve_loop(w_on=True, prox_on=True)
     ph.W = ph.W_new
-    idx = np.asarray(batch.nonant_idx)
-    col_in = np.zeros(batch.n, bool)
-    col_in[batch.template.var_slices["u"]] = True
-    pin = col_in[idx]
-    _progress(f"gap warmup S={S}: dive")
-    cands, feas = ph.dive_nonant_candidates(np.asarray(ph.xbar),
-                                            dive_slots=pin)
-    _progress(f"gap warmup S={S}: incumbent eval")
-    ph.calculate_incumbent(cands[0], pin_mask=pin)
+    if dive:
+        idx = np.asarray(batch.nonant_idx)
+        col_in = np.zeros(batch.n, bool)
+        col_in[batch.template.var_slices["u"]] = True
+        pin = col_in[idx]
+        _progress(f"gap warmup S={S}: dive")
+        cands, feas = ph.dive_nonant_candidates(np.asarray(ph.xbar),
+                                                dive_slots=pin)
+        _progress(f"gap warmup S={S}: incumbent eval")
+        ph.calculate_incumbent(cands[0], pin_mask=pin)
     del ph
 
 
 def _run_gap_wheel(S, metric_prefix, baseline_s, max_iterations,
-                   note, rel_gap=0.008):
+                   note, rel_gap=0.008, xhat_extra=None):
     from mpisppy_tpu.utils.sputils import spin_the_wheel
 
-    _warm_gap_programs(S)
+    uses_dive = not (xhat_extra or {}).get("xhat_oracle_candidates",
+                                           False)
+    _warm_gap_programs(S, dive=uses_dive)
     _progress(f"{metric_prefix}: building wheel (S={S})")
-    hd, sds = _wheel(S, max_iterations=max_iterations, rel_gap=rel_gap)
+    hd, sds = _wheel(S, max_iterations=max_iterations, rel_gap=rel_gap,
+                     xhat_extra=xhat_extra)
     _progress(f"{metric_prefix}: spinning")
     t0 = time.perf_counter()
     res = spin_the_wheel(hd, sds)
@@ -365,8 +400,18 @@ def bench_uc10_gap():
 
 
 def bench_uc1024_gap():
+    # at S=1024 the device dive costs tens of minutes per candidate
+    # (measured) — the incumbent source is the host oracle instead:
+    # ONE scenario's exact MILP first stage per pass, evaluated exactly
+    # across all 1024 scenarios by the pinned-dispatch LPs
     _run_gap_wheel(
-        1024, "uc1024", baseline_s=0.0, max_iterations=30,
+        1024, "uc1024", baseline_s=0.0, max_iterations=20,
+        xhat_extra={"xhat_oracle_candidates": True,
+                    "xhat_dive_candidates": False,
+                    "xhat_scen_limit": 1,
+                    "xhat_oracle_time_limit": 120.0,
+                    "xhat_oracle_gap": 5e-3,
+                    "xhat_min_interval": 60.0},
         note="the north-star scale (ref. paperruns/larger_uc/"
              "1000scenarios_wind, SLURM targets 64 ranks + Gurobi; no "
              "published wall time exists, so vs_baseline is 0 by "
@@ -430,9 +475,12 @@ def main():
     enable_honest_f32()
     _wait_for_headroom()
     bench_throughput()
+    # the two S=1024 metrics run back to back so the gap wheel reuses
+    # the s/iter metric's device setup and compiled programs
     bench_1024()
-    bench_uc10_gap()
     bench_uc1024_gap()
+    _release_device(1024)
+    bench_uc10_gap()
 
 
 if __name__ == "__main__":

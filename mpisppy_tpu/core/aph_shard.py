@@ -200,11 +200,15 @@ class APHShard(APH):
             np.asarray(self.W, np.float64).reshape(-1)
         buf[off + lo:off + lo + S_loc * K] = \
             np.asarray(xn, np.float64).reshape(-1)
+        if self.spcomm is None:
+            # non-hub shards PUBLISH only — the read+sum of n_shards
+            # 2*S*K vectors per iteration would be pure waste on their
+            # hot loop (the hub shard does the one gather below)
+            self.sync.publish_now("WX", buf)
+            return False
         # on-demand gather (disjoint rows -> the sum is an exact
         # concat, stale for other shards by at most their publish lag)
         g = self.sync.reduce_now("WX", buf)
-        if self.spcomm is None:
-            return False
         self.wheel_W = g[:off].reshape(self._wheel_S, K)
         self.wheel_X = g[off:].reshape(self._wheel_S, K)
         self.spcomm.sync()

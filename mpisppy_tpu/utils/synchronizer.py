@@ -115,6 +115,12 @@ class Synchronizer:
         lens.update(self.ondemand_lens)
         self._sync_round = 0
         if windows is not None:
+            missing = set(lens) - set(windows)
+            if missing:
+                raise ValueError(
+                    f"prebuilt window table is missing {sorted(missing)}; "
+                    "thread-mode embedders must size on-demand rows too "
+                    "(make_thread_windows(..., ondemand_lens=...))")
             self._windows = windows
         elif shm_prefix is not None:
             self._windows = self._open_shm(shm_prefix, lens, open_timeout)
@@ -124,12 +130,14 @@ class Synchronizer:
 
     # ---- construction helpers ----
     @staticmethod
-    def make_thread_windows(names_lens, n_participants):
+    def make_thread_windows(names_lens, n_participants, ondemand_lens=None):
         """One shared window table for an n-thread group (test/in-process
         mode): {red: [Window]*n}. Pass the SAME table to every
         participant's constructor."""
+        lens = _augment_lens(names_lens)
+        lens.update(ondemand_lens or {})
         return {r: [Window(l) for _ in range(n_participants)]
-                for r, l in _augment_lens(names_lens).items()}
+                for r, l in lens.items()}
 
     def _open_shm(self, prefix, lens, timeout):
         out = {}
@@ -212,6 +220,13 @@ class Synchronizer:
             if self.enable_side_gig:
                 raise RuntimeError("side gig already enabled")
             self.enable_side_gig = True
+
+    def publish_now(self, redname, local_vec):
+        """Publish my summand of an ON-DEMAND reduction without summing
+        (non-consumers of a gather publish only — the read+sum over all
+        peers is the consumer's cost, see reduce_now)."""
+        self._windows[redname][self.me].put(
+            np.asarray(local_vec, dtype=np.float64))
 
     def reduce_now(self, redname, local_vec):
         """One wait-free sum of an ON-DEMAND reduction (see
