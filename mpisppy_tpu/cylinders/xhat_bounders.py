@@ -80,27 +80,42 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             if key == getattr(self, "_last_key", None):
                 continue
             self._last_key = key
-            obj = self.opt.calculate_incumbent(xhat,
-                                               pin_mask=self._pin_mask)
-            if obj is not None and (self.bound is None or obj < self.bound):
-                # ``xhat_exact_eval``: re-evaluate the improving
-                # candidate on the HOST oracle (fixed nonants, exact
-                # dispatch). At df32 scale the device evaluator's
-                # tolerance-level feasibility can mis-state
-                # penalty-dominated objectives by (violation × VOLL) —
-                # the published INNER bound must be a true upper bound,
-                # so the host value replaces the device estimate (and a
-                # host-infeasible candidate publishes nothing).
-                if self.options.get("xhat_exact_eval", False):
-                    status, exact = self._exact_eval(xhat)
-                    if status == "ok":
-                        if exact is None or (self.bound is not None
-                                             and exact >= self.bound):
-                            continue       # host-infeasible or no gain
-                        obj = exact
-                    # "unavailable": publish the device value as before
-                self.best_xhat = self.opt.round_nonants(xhat)
-                self.update_bound(obj)
+            exact_on = self.options.get("xhat_exact_eval", False)
+            # ``xhat_device_prescreen``: gate candidates through the
+            # batched device evaluation before paying the host oracle.
+            # At scales where the device engine's fixed-mode states are
+            # themselves gigabytes (S=1024 reference UC), exact-eval
+            # wheels turn it OFF and go straight to the host.
+            if not exact_on \
+                    or self.options.get("xhat_device_prescreen", True):
+                obj = self.opt.calculate_incumbent(
+                    xhat, pin_mask=self._pin_mask)
+                if obj is None or (self.bound is not None
+                                   and obj >= self.bound):
+                    continue
+            else:
+                obj = None
+            # ``xhat_exact_eval``: re-evaluate the improving candidate
+            # on the HOST oracle (fixed nonants, exact dispatch). At
+            # df32 scale the device evaluator's tolerance-level
+            # feasibility can mis-state penalty-dominated objectives by
+            # (violation × VOLL) — the published INNER bound must be a
+            # true upper bound, so the host value replaces the device
+            # estimate (and a host-infeasible candidate publishes
+            # nothing).
+            if exact_on:
+                status, exact = self._exact_eval(xhat)
+                if status == "ok":
+                    if exact is None or (self.bound is not None
+                                         and exact >= self.bound):
+                        continue           # host-infeasible or no gain
+                    obj = exact
+                # "unavailable": fall back to the device value (if the
+                # prescreen was off too, there is nothing to publish)
+            if obj is None:
+                continue
+            self.best_xhat = self.opt.round_nonants(xhat)
+            self.update_bound(obj)
 
     def _exact_eval(self, xhat):
         """("ok", value-or-None) from the host oracle, or
@@ -163,6 +178,27 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
                 X, dive_slots=self._pin_mask)
             take = ~filled & np.asarray(feasible)
             out[take] = np.asarray(cands)[take]
+            filled |= take
+        if not filled.all() and self._pin_mask is not None \
+                and self.options.get("xhat_union_fallback", False):
+            # ROBUSTIFIED fallbacks for covering-style pinned integers
+            # (UC commitments): a single scenario's optimal plan is
+            # routinely infeasible for other scenarios (under-committed
+            # against their realizations — measured: every per-scenario
+            # MILP candidate rejected by the exact evaluator at
+            # reference scale). Unfilled rows get the elementwise MAX
+            # over the filled candidates ("commit if any scenario's
+            # optimum commits"); with nothing filled, the pinned upper
+            # bounds (maximum commitment — always covering). The exact
+            # evaluator remains the feasibility gate either way.
+            pm = self._pin_mask
+            if filled.any():
+                union = out[filled][:, pm].max(axis=0)
+            else:
+                union = np.asarray(self.opt.batch.ub)[0][
+                    np.asarray(self.opt.batch.nonant_idx)][pm]
+            rows = np.flatnonzero(~filled)
+            out[np.ix_(rows, np.flatnonzero(pm))] = union
         return out
 
     def _oracle_candidates(self, out):
