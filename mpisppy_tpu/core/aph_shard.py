@@ -208,7 +208,15 @@ class APHShard(APH):
             return False
         # on-demand gather (disjoint rows -> the sum is an exact
         # concat, stale for other shards by at most their publish lag)
-        g = self.sync.reduce_now("WX", buf)
+        g, min_wid = self.sync.reduce_now("WX", buf, return_min_wid=True)
+        if min_wid < 1:
+            # a shard has not published its first WX summand yet: the
+            # gather holds zero rows for it, and staging that would
+            # hand spokes partially-zero (W, x) — W-projection keeps
+            # outer bounds valid but xhat spokes would burn dive/oracle
+            # passes on zero-row candidate blocks (ADVICE r4). Skip the
+            # cylinder sync this round; the next gather retries.
+            return False
         self.wheel_W = g[:off].reshape(self._wheel_S, K)
         self.wheel_X = g[off:].reshape(self._wheel_S, K)
         self.spcomm.sync()

@@ -133,7 +133,7 @@ def _hot_eps(prox_on, sub_eps, sub_eps_hot):
 def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                  sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
                  tail_iter, stall_rel, segment, polish_hot, polish_chunk,
-                 segment_lo=None):
+                 segment_lo=None, ir_sweeps=1):
     """The ONE precision-policy + solver dispatch, shared by the fused
     step and the chunked loop (a second copy would silently drift).
 
@@ -164,13 +164,15 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                               polish_chunk=polish_chunk,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
                               stall_rel=stall_rel, segment=segment,
-                              segment_lo=segment_lo, polish=do_polish)
+                              segment_lo=segment_lo, polish=do_polish,
+                              ir_sweeps=ir_sweeps)
     return qp_solve_segmented(factors, d, q, qp_state,
                               max_iter=sub_max_iter, segment=segment,
                               eps_abs=e_pri, eps_rel=e_pri,
                               polish_chunk=polish_chunk,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
-                              stall_rel=stall_rel, polish=do_polish)
+                              stall_rel=stall_rel, polish=do_polish,
+                              ir_sweeps=ir_sweeps)
 
 
 def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
@@ -178,7 +180,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
              polish_chunk, precision="native", tail_iter=1000,
              sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
-             segment=500, polish_hot=True, segment_lo=None):
+             segment=500, polish_hot=True, segment_lo=None, ir_sweeps=1):
     """The PH iteration: batched subproblem solve + Compute_Xbar +
     Update_W + convergence + objectives + certified dual bound, staged as
     THREE jitted programs (assemble / solve / reduce) rather than one
@@ -204,7 +206,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
         sub_eps_hot=sub_eps_hot, sub_eps_dua_hot=sub_eps_dua_hot,
         tail_iter=tail_iter, stall_rel=stall_rel, segment=segment,
         polish_hot=polish_hot, polish_chunk=polish_chunk,
-        segment_lo=segment_lo)
+        segment_lo=segment_lo, ir_sweeps=ir_sweeps)
     wmask = None if wscale is None else wscale > 0
     (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
      dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
@@ -275,6 +277,10 @@ class PHBase(SPBase):
         self.sub_segment = int(opts.get("subproblem_segment", 500))
         _sl = opts.get("subproblem_segment_lo", None)
         self.sub_segment_lo = None if _sl is None else int(_sl)
+        # df32 x-update IR sweeps (see qp_solver._m_solve_ir: one sweep
+        # lands at ~(κ·eps32)² ≈ 2e-7, far below any df32-scale
+        # tolerance; raise for pathologically conditioned models)
+        self.sub_ir_sweeps = int(opts.get("subproblem_ir_sweeps", 1))
         self.sub_polish_hot = bool(opts.get("subproblem_polish_hot", True))
         if self.sub_precision in ("mixed", "df32") \
                 and self.dtype != jnp.float64:
@@ -579,7 +585,8 @@ class PHBase(SPBase):
                   stall_rel=self.sub_stall_rel, segment=self.sub_segment,
                   polish_hot=self.sub_polish_hot,
                   polish_chunk=polish_chunk,
-                  segment_lo=self.sub_segment_lo)
+                  segment_lo=self.sub_segment_lo,
+                  ir_sweeps=self.sub_ir_sweeps)
         # pass 1 — solves only. (Segmented solves sync on their own
         # iteration counters internally, so chunks still run in
         # sequence; the three-pass split buys a SINGLE recovery
@@ -961,7 +968,8 @@ class PHBase(SPBase):
             sub_eps_dua_hot=self.sub_eps_dua_hot,
             stall_rel=self.sub_stall_rel, segment=self.sub_segment,
             polish_hot=self.sub_polish_hot,
-            segment_lo=self.sub_segment_lo)
+            segment_lo=self.sub_segment_lo,
+            ir_sweeps=self.sub_ir_sweeps)
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB

@@ -75,14 +75,23 @@ def ship_shared_matrix(A2d, t, split=False):
         and sparse_bytes < dense_bytes // 8
 
     if split:
+        from ..ops.packed import analyze_structure
+
+        # host structure discovery (ops/packed.py) while the pattern is
+        # in hand: the skeleton ships as kilobytes of indices and lets
+        # qp_setup build the packed matvec form that carries the hot
+        # loop (BENCH_r04's 3.8% MFU was dense passes streaming zeros)
+        struct = analyze_structure(rows, cols, A.shape[0], A.shape[1])
         hi_np, lo_np = split_f32_np(A)
         if not use_scatter:
-            return SplitMatrix(jnp.asarray(hi_np), jnp.asarray(lo_np))
+            return SplitMatrix(jnp.asarray(hi_np), jnp.asarray(lo_np),
+                               struct=struct)
         r = jnp.asarray(rows.astype(np.int32))
         c = jnp.asarray(cols.astype(np.int32))
         z = jnp.zeros(A.shape, jnp.float32)
         return SplitMatrix(z.at[r, c].set(jnp.asarray(hi_np[rows, cols])),
-                           z.at[r, c].set(jnp.asarray(lo_np[rows, cols])))
+                           z.at[r, c].set(jnp.asarray(lo_np[rows, cols])),
+                           struct=struct)
     if not use_scatter:
         return jnp.asarray(A, t)
     r = jnp.asarray(rows.astype(np.int32))
@@ -269,8 +278,14 @@ class SPBase:
             from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel.mesh import scenario_sharding
             shard = lambda a: jax.device_put(a, scenario_sharding(mesh, a.ndim))
-            repl = lambda a: jax.device_put(
-                a, NamedSharding(mesh, PartitionSpec(*([None] * a.ndim))))
+            # replicate per LEAF: a packed SplitMatrix mixes ranks
+            # (dense (m, n) + index vectors), so one container-rank
+            # spec would reject the rank-1 leaves
+            repl = lambda a: jax.tree.map(
+                lambda leaf: jax.device_put(
+                    leaf,
+                    NamedSharding(mesh, PartitionSpec(*([None] * leaf.ndim)))),
+                a)
             self.prob = shard(self.prob)
             if self.vprob is not None:
                 self.vprob = shard(self.vprob)

@@ -105,13 +105,20 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             # nothing).
             if exact_on:
                 status, exact = self._exact_eval(xhat)
-                if status == "ok":
-                    if exact is None or (self.bound is not None
-                                         and exact >= self.bound):
-                        continue           # host-infeasible or no gain
-                    obj = exact
-                # "unavailable": fall back to the device value (if the
-                # prescreen was off too, there is nothing to publish)
+                if status != "ok":
+                    # the oracle cannot run here: publish NOTHING. The
+                    # caller configured exact eval precisely because the
+                    # device estimate is untrusted at this scale
+                    # (tolerance-level feasibility can mis-state
+                    # penalty-dominated objectives by violation × VOLL)
+                    # — falling back to it would terminate a "certified"
+                    # gap on the very value the option distrusts
+                    # (ADVICE r4).
+                    continue
+                if exact is None or (self.bound is not None
+                                     and exact >= self.bound):
+                    continue               # host-infeasible or no gain
+                obj = exact
             if obj is None:
                 continue
             self.best_xhat = self.opt.round_nonants(xhat)
@@ -137,7 +144,9 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
         except Exception as e:
             from .. import global_toc
             global_toc(f"{type(self).__name__}: exact incumbent eval "
-                       f"unavailable ({e!r}); keeping device values")
+                       f"unavailable ({e!r}); NOT publishing inner "
+                       "bounds (exact eval was configured because the "
+                       "device estimate is untrusted at this scale)")
             if self._oracle_pool is None:
                 self._oracle_pool = False
             return "unavailable", None

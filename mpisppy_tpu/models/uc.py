@@ -71,16 +71,60 @@ def min_up_down_times(num_gens: int):
     return ut, ut.copy()
 
 
+def t0_fleet_state(num_gens: int, seed: int = 4321):
+    """Warm-fleet initial conditions — the UnitOnT0State /
+    PowerGeneratedT0 parameter block of the reference's data files
+    (ref. examples/uc/2013-05-11/Scenario_1.dat: per-generator signed
+    on/off hours at t=0 plus the T0 dispatch level). A cold fleet
+    (u[g,-1]=0 everywhere) lets every unit start fresh, which distorts
+    early-horizon commitment economics against the instance the
+    baselines were earned on (VERDICT r4 #6/missing #3).
+
+    Returns (on0 bool, spent hours in the current state [1..UT/DT], p0
+    MW): the baseload-heavy ~55% of the fleet arrives ON partway
+    through its min-up window (so remaining-obligation rows BIND),
+    the rest OFF partway through min-down."""
+    fl = fleet(num_gens)
+    ut, dt_ = min_up_down_times(num_gens)
+    rng = np.random.RandomState(seed)
+    on0 = np.linspace(0.0, 1.0, num_gens) < 0.55
+    window = np.where(on0, ut, dt_).astype(int)
+    spent = 1 + (np.arange(num_gens) % np.maximum(1, window))
+    p0 = np.where(on0, fl["pmin"]
+                  + 0.6 * (fl["pmax"] - fl["pmin"]) * rng.rand(num_gens),
+                  0.0)
+    return on0, spent, p0
+
+
 def scenario_creator(scenario_name, num_gens=10, num_hours=24,
                      relax_integrality=True, min_up_down=False,
-                     ramping=False) -> Model:
+                     ramping=False, t0_state=False,
+                     startup_shutdown_ramps=False) -> Model:
     """``min_up_down`` adds the Rajan–Takriti turn-on inequalities
     (sum of startups in a UT_g window <= u, and in a DT_g window <=
     1 - u shifted) and ``ramping`` adds second-stage dispatch ramp rows
     |p_t - p_{t-1}| <= r_g — the constraint families that make egret's
     UC a real unit-commitment model rather than a static dispatch
     (ref. examples/uc/uc_funcs.py egret model; both default OFF to keep
-    the benchmark instance definition stable)."""
+    the benchmark instance definition stable).
+
+    ``t0_state`` (r5) threads warm-fleet initial conditions through the
+    model the way the reference's data files do (UnitOnT0State /
+    PowerGeneratedT0, ref. examples/uc/2013-05-11/Scenario_1.dat):
+    the t=0 startup definition sees u[g,-1], remaining min-up/down
+    obligations pin the early-horizon commitment bounds (the standard
+    lowering of pre-horizon R-T windows), the early min-down rhs uses
+    the pre-horizon schedule, and — with ramping on — t=0 ramp rows
+    tie first-hour output to PowerGeneratedT0.
+
+    ``startup_shutdown_ramps`` (r5) replaces the symmetric implicit
+    allowance (pmin + ramp on every row) with DISTINCT startup and
+    shutdown limits (StartupRampLimit / ShutdownRampLimit in the
+    reference's parameter block), linear in the existing variables:
+        up:   p̄_t − p̄_{t−1} ≤ RU·u_{t−1} + SU·st_t
+        down: p̄_{t−1} − p̄_t ≤ SD·u_{t−1} + (RD − SD)·u_t
+    with p̄ = pmin·u + p total output; both reduce to the classic
+    Carrión–Arroyo rows on the {0,1} commitment patterns."""
     import re
     scennum = int(re.search(r"(\d+)$", scenario_name).group(1))
     fl = fleet(num_gens)
@@ -89,9 +133,28 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
     G, T = num_gens, num_hours
     dP = fl["pmax"] - fl["pmin"]
 
+    on0 = spent0 = p0 = None
+    u_lb = np.zeros(G * T)
+    u_ub = np.ones(G * T)
+    if t0_state:
+        on0, spent0, p0 = t0_fleet_state(G)
+        ut0, dt0 = min_up_down_times(G)
+        if min_up_down:
+            # remaining min-up/down obligation at t=0 pins the early
+            # commitments — the standard lowering of pre-horizon
+            # Rajan–Takriti windows into variable bounds
+            for g in range(G):
+                if on0[g]:
+                    for t in range(min(T, int(ut0[g]) - int(spent0[g]))):
+                        u_lb[g * T + t] = 1.0
+                else:
+                    for t in range(min(T, int(dt0[g]) - int(spent0[g]))):
+                        u_ub[g * T + t] = 0.0
+
     m = Model(scenario_name, sense="min")
     # commitment u[g,t] and startups st[g,t] flattened g-major
-    u = m.var("u", G * T, lb=0.0, ub=1.0, integer=not relax_integrality, stage=1)
+    u = m.var("u", G * T, lb=u_lb, ub=u_ub,
+              integer=not relax_integrality, stage=1)
     st = m.var("st", G * T, lb=0.0, ub=1.0, integer=not relax_integrality, stage=1)
     p = m.var("p", G * T, lb=0.0, stage=2)
     shed = m.var("shed", T, lb=0.0, ub=load, stage=2)
@@ -115,14 +178,19 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
             Du[gt(g, t), gt(g, t)] = dP[g]
     m.constr(p - (Du @ u) <= 0.0, name="capacity")
 
-    # startup definition: st[g,t] >= u[g,t] - u[g,t-1] (u[g,-1] = 0)
+    # startup definition: st[g,t] >= u[g,t] - u[g,t-1]; at t=0 the
+    # predecessor is the T0 state (u[g,-1] = on0, a constant on the
+    # rhs) — cold fleet (0) without t0_state
     Su = np.zeros((G * T, G * T))
+    rhs_su = np.zeros(G * T)
     for g in range(G):
         for t in range(T):
             Su[gt(g, t), gt(g, t)] = 1.0
             if t > 0:
                 Su[gt(g, t), gt(g, t - 1)] = -1.0
-    m.constr(st - (Su @ u) >= 0.0, name="startup_def")
+            elif t0_state and on0[g]:
+                rhs_su[gt(g, 0)] = -1.0
+    m.constr(st - (Su @ u) >= rhs_su, name="startup_def")
 
     # reserve: sum_g Pmax_g u_gt >= (1+r)load_t - wind_t
     Ru = np.zeros((T, G * T))
@@ -136,6 +204,17 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
         #   sum_{tau in (t-UT_g, t]} st[g,tau] <= u[g,t]        (min up)
         #   sum_{tau in (t-DT_g, t]} st[g,tau] <= 1 - u[g,t-DT] (min down)
         ut, dt_ = min_up_down_times(G)
+
+        def u_past(g, tau):
+            """Pre-horizon commitment at hour tau < 0 under the T0
+            state: the unit has held its current state for spent0[g]
+            hours, and (by construction) the opposite state before."""
+            if not t0_state:
+                return 0.0
+            if tau >= -int(spent0[g]):
+                return 1.0 if on0[g] else 0.0
+            return 0.0 if on0[g] else 1.0
+
         Mu = np.zeros((G * T, G * T))   # window-sum of st
         Uu = np.zeros((G * T, G * T))   # u[g,t]
         Md = np.zeros((G * T, G * T))
@@ -151,29 +230,92 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
                     Md[gt(g, t), gt(g, tau)] = 1.0
                 if t0 >= 0:
                     Ud[gt(g, t), gt(g, t0)] = 1.0
-                rhs_d[gt(g, t)] = 1.0
+                    rhs_d[gt(g, t)] = 1.0
+                else:
+                    # pre-horizon u[g,t0] is a constant: rhs absorbs it
+                    rhs_d[gt(g, t)] = 1.0 - u_past(g, t0)
         m.constr((Mu @ st) - (Uu @ u) <= 0.0, name="min_uptime")
         m.constr((Md @ st) + (Ud @ u) <= rhs_d, name="min_downtime")
 
     if ramping:
-        # ramp rows on TOTAL output pmin_g*u + p (a pure-p ramp would let
-        # commitment flips jump real output by pmin with no limit); the
-        # startup/shutdown allowance is pmin + ramp, the egret-style
-        # startup ramp relaxation
+        # ramp rows on TOTAL output p̄ = pmin_g·u + p (a pure-p ramp
+        # would let commitment flips jump real output by pmin with no
+        # limit). Classic symmetric form: allowance ramp + pmin on
+        # every row (egret-style startup ramp relaxation). With
+        # startup_shutdown_ramps, DISTINCT startup/shutdown limits
+        # enter linearly through u/st (see the docstring; RU/RD the
+        # hot ramp, SU/SD the start/stop allowances — the
+        # StartupRampLimit/ShutdownRampLimit block of the reference's
+        # data files, ref. examples/uc/2013-05-11/Scenario_1.dat)
         ramp = 0.5 * dP + 0.1 * fl["pmax"]
-        Rp = np.zeros((G * (T - 1), G * T))
-        Rut = np.zeros((G * (T - 1), G * T))
-        rr = np.zeros(G * (T - 1))
-        for g in range(G):
-            for t in range(1, T):
-                r = g * (T - 1) + (t - 1)
-                Rp[r, gt(g, t)] = 1.0
-                Rp[r, gt(g, t - 1)] = -1.0
-                Rut[r, gt(g, t)] = fl["pmin"][g]
-                Rut[r, gt(g, t - 1)] = -fl["pmin"][g]
-                rr[r] = ramp[g] + fl["pmin"][g]
-        m.constr((Rp @ p) + (Rut @ u) <= rr, name="ramp_up")
-        m.constr((Rp @ p) + (Rut @ u) >= -rr, name="ramp_down")
+        # validity of the down row's linear form needs SD − RD ≤ pmin
+        # (else the startup pattern would get a spurious output floor
+        # above the pmin the capacity rows already imply): holds here
+        # since SD − RD = pmin − ½·ramp < pmin
+        su_lim = fl["pmin"] + 0.5 * ramp      # startup: reach pmin + ½RU
+        sd_lim = fl["pmin"] + 0.5 * ramp      # shutdown allowance
+        if not startup_shutdown_ramps:
+            # rows run t = 0..T-1 when the T0 dispatch anchors t=0
+            # (p̄[g,-1] = PowerGeneratedT0 moves to the rhs with the
+            # symmetric allowance), t = 1..T-1 otherwise
+            tlo = 0 if t0_state else 1
+            nr = G * (T - tlo)
+            Rp = np.zeros((nr, G * T))
+            Rut = np.zeros((nr, G * T))
+            rr_up = np.zeros(nr)
+            rr_dn = np.zeros(nr)
+            for g in range(G):
+                for t in range(tlo, T):
+                    r = g * (T - tlo) + (t - tlo)
+                    Rp[r, gt(g, t)] = 1.0
+                    Rut[r, gt(g, t)] = fl["pmin"][g]
+                    allow = ramp[g] + fl["pmin"][g]
+                    if t > 0:
+                        Rp[r, gt(g, t - 1)] = -1.0
+                        Rut[r, gt(g, t - 1)] = -fl["pmin"][g]
+                        rr_up[r] = allow
+                        rr_dn[r] = -allow
+                    else:
+                        rr_up[r] = allow + p0[g]
+                        rr_dn[r] = -allow + p0[g]
+            m.constr((Rp @ p) + (Rut @ u) <= rr_up, name="ramp_up")
+            m.constr((Rp @ p) + (Rut @ u) >= rr_dn, name="ramp_down")
+        else:
+            # rows run t = 0..T-1 when the T0 dispatch anchors t=0
+            # (p̄[g,-1] = PowerGeneratedT0, a constant on the rhs),
+            # t = 1..T-1 otherwise
+            tlo = 0 if t0_state else 1
+            nr = G * (T - tlo)
+            Ru_p = np.zeros((nr, G * T))      # up rows: coeffs on p
+            Ru_u = np.zeros((nr, G * T))      # up rows: coeffs on u
+            Ru_st = np.zeros((nr, G * T))     # up rows: coeffs on st
+            rr_u = np.zeros(nr)
+            Rd_p = np.zeros((nr, G * T))
+            Rd_u = np.zeros((nr, G * T))
+            rr_d = np.zeros(nr)
+            pmin = fl["pmin"]
+            for g in range(G):
+                for t in range(tlo, T):
+                    r = g * (T - tlo) + (t - tlo)
+                    # up: p̄_t − p̄_{t−1} − RU·u_{t−1} − SU·st_t ≤ 0
+                    Ru_p[r, gt(g, t)] = 1.0
+                    Ru_u[r, gt(g, t)] = pmin[g]
+                    Ru_st[r, gt(g, t)] = -su_lim[g]
+                    # down: p̄_{t−1} − p̄_t − SD·u_{t−1} − (RD−SD)·u_t ≤ 0
+                    Rd_p[r, gt(g, t)] = -1.0
+                    Rd_u[r, gt(g, t)] = -pmin[g] - (ramp[g] - sd_lim[g])
+                    if t > 0:
+                        Ru_p[r, gt(g, t - 1)] = -1.0
+                        Ru_u[r, gt(g, t - 1)] = -pmin[g] - ramp[g]
+                        Rd_p[r, gt(g, t - 1)] = 1.0
+                        Rd_u[r, gt(g, t - 1)] = pmin[g] - sd_lim[g]
+                    else:
+                        # T0 anchors: p̄_{-1} = p0_g, u_{-1} = on0_g
+                        rr_u[r] = p0[g] + ramp[g] * float(on0[g])
+                        rr_d[r] = -p0[g] + sd_lim[g] * float(on0[g])
+            m.constr((Ru_p @ p) + (Ru_u @ u) + (Ru_st @ st) <= rr_u,
+                     name="ramp_up")
+            m.constr((Rd_p @ p) + (Rd_u @ u) <= rr_d, name="ramp_down")
 
     cu = np.repeat(fl["noload"], T)
     cst = np.repeat(fl["startup"], T)
@@ -185,7 +327,8 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
 
 def scenario_vector_patch(scenario_name, num_gens=10, num_hours=24,
                           relax_integrality=True, min_up_down=False,
-                          ramping=False):
+                          ramping=False, t0_state=False,
+                          startup_shutdown_ramps=False):
     """Structure-shared fast path for build_batch(vector_patch=...): the
     ONLY scenario-dependent data in a UC scenario is the wind trace,
     which enters the balance rhs, the reserve rhs, and the spill upper

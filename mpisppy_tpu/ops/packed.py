@@ -1,0 +1,251 @@
+"""Structure-packed shared constraint matrix: the matvec representation
+that stops the ADMM hot loop from streaming gigabytes of zeros.
+
+The reference hands each scenario LP/MIP to Gurobi, whose simplex works
+the ~101k-nonzero sparse matrix directly (ref. examples/uc/2013-05-11:
+~0.03% dense at 25836 x 25836-ish scale). The TPU kernel's dense matmul
+formulation (ops/qp_solver._Ax) instead reads the full (m, n) f32 pair
+from HBM on every pass — at reference-UC scale that is ~2.7 GB per
+split matvec and ~80% of the hot loop's memory traffic, which is why
+BENCH_r04 measured 3.8% MFU (the chip spends its bandwidth on zeros).
+
+TPUs have no efficient general gather/scatter sparse matmul, but SP
+constraint matrices are not generally sparse — they are STRUCTURED:
+
+ - a few GLOBAL rows coupling most columns (UC: the per-hour balance
+   and reserve rows — 96 of 25836 rows), and
+ - a block-local remainder: rows touching only one small column group
+   (UC: capacity/startup/min-up/min-down/ramp rows of one generator
+   touch only that generator's u/st/p columns).
+
+Union-find on the host sparsity pattern (already in hand at ship time —
+core/spbase.ship_shared_matrix scatters from it) discovers this
+generically, with no model-specific code: rows above an nnz threshold
+go global, the rest partition into connected components of shared
+columns. The packed form is then
+
+    A x  =  scatter_rows( einsum over (C, mr, nc) component blocks )
+          + scatter_rows( G @ x )            with G the (R, n) global rows
+
+— one small batched MXU matmul plus one thin dense matmul plus two
+gathers/scatters, all XLA-native. On the 90x48 UC instance the packed
+operand set is ~1.5% of the dense matrix's bytes (C=90 components of
+286 x 144 plus 96 global rows), turning every A-pass from ~3.4 ms of
+HBM streaming into ~0.2 ms of mostly-MXU work. Models without local
+structure simply fail the profitability test and keep the dense path.
+
+Exactness: each nonzero lands in exactly one term (component blocks are
+bounding boxes over disjoint row/column sets; global rows are disjoint
+from local rows), so packed apply equals dense apply up to f32 summation
+order. df32 callers accumulate the three split passes in f64 exactly as
+the dense path does (ops/qp_solver.SplitMatrix).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PackStructure(NamedTuple):
+    """Host-derived index skeleton (values not yet attached). Index
+    arrays are pytree children (device-shippable); padding entries are
+    -1 and masked at pack() time — padding with a real index would
+    gather that row's true values into slots that must read zero."""
+    g_rows: jax.Array      # (R,) int32 global-row indices (may be empty)
+    l_rows: jax.Array      # (C, mr) int32, -1 padded
+    l_cols: jax.Array      # (C, nc) int32, -1 padded
+
+
+class Packed(NamedTuple):
+    """PackStructure + gathered values for ONE dense matrix. Indices
+    here are clamped to valid range (masking already applied to vals)."""
+    g_rows: jax.Array      # (R,) int32
+    g_vals: jax.Array      # (R, n)
+    l_rows: jax.Array      # (C, mr) int32, padding clamped to 0
+    l_cols: jax.Array      # (C, nc) int32, padding clamped to 0
+    l_vals: jax.Array      # (C, mr, nc), padded rows/cols zeroed
+
+
+def analyze_structure(rows, cols, m, n, nnz_thresholds=None,
+                      max_tile=2048, max_traffic_ratio=0.35,
+                      max_global_frac=0.25, max_attempts=16):
+    """Host structure discovery from the COO pattern (rows, cols).
+    Returns a PackStructure, or None when the matrix has no profitable
+    global/local split (callers keep the dense path).
+
+    Tries progressively stricter nnz thresholds for the global-row set:
+    a looser threshold keeps more rows local (cheaper), but a hub-like
+    row (UC balance: 182 nnz) left local would union every generator
+    into one giant component. The ladder is DERIVED from the distinct
+    per-row nnz values (descending) — fixed rungs miss instances whose
+    coupling rows (reserve: G nnz) sit between them at small G.
+    Accepts the first threshold whose components fit (max_tile) and
+    whose packed operand bytes are below ``max_traffic_ratio`` of
+    dense."""
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    if rows.size == 0:
+        return None
+    row_nnz = np.bincount(rows, minlength=m)
+    if nnz_thresholds is None:
+        # thr = v keeps rows with nnz <= v local; each distinct value
+        # is a potential cut between "local" and "coupling" rows
+        distinct = np.unique(row_nnz[row_nnz > 1])[::-1]
+        if distinct.size > max_attempts:
+            # keep the small end dense (fine cuts matter there) and
+            # subsample the large end
+            head = distinct[distinct <= 64]
+            tail = distinct[distinct > 64]
+            if tail.size > max_attempts - head.size:
+                sel = np.linspace(0, tail.size - 1,
+                                  max(1, max_attempts - head.size))
+                tail = tail[sel.astype(int)]
+            distinct = np.concatenate([tail, head])[:max_attempts]
+        nnz_thresholds = [int(v) for v in distinct]
+
+    for thr in nnz_thresholds:
+        g_mask = row_nnz > thr
+        if g_mask.sum() > max_global_frac * m:
+            continue
+        local = ~g_mask[rows]
+        lr, lc = rows[local], cols[local]
+        if lr.size == 0:
+            return None
+        # union-find over columns, merging through each local row
+        parent = np.arange(n, dtype=np.int64)
+
+        def find(i):
+            root = i
+            while parent[root] != root:
+                root = parent[root]
+            while parent[i] != root:
+                parent[i], i = root, parent[i]
+            return root
+
+        order = np.argsort(lr, kind="stable")
+        lr_s, lc_s = lr[order], lc[order]
+        starts = np.searchsorted(lr_s, np.unique(lr_s))
+        bounds = np.append(starts, lr_s.size)
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            r0 = find(lc_s[a])
+            for c in lc_s[a + 1:b]:
+                parent[find(c)] = r0
+        roots = np.array([find(c) for c in np.unique(lc)])
+        used_cols = np.unique(lc)
+        comp_of_col = {c: r for c, r in zip(used_cols, roots)}
+        comp_ids = {}
+        for r in roots:
+            comp_ids.setdefault(r, len(comp_ids))
+        C = len(comp_ids)
+        col_lists = [[] for _ in range(C)]
+        for c in used_cols:
+            col_lists[comp_ids[comp_of_col[c]]].append(c)
+        # each local row belongs to its first column's component
+        row_ids = np.unique(lr_s)
+        row_first_col = lc_s[bounds[:-1]]
+        row_lists = [[] for _ in range(C)]
+        for r, c0 in zip(row_ids, row_first_col):
+            row_lists[comp_ids[comp_of_col[c0]]].append(r)
+        mr = max(len(x) for x in row_lists)
+        nc = max(len(x) for x in col_lists)
+        if mr > max_tile or nc > max_tile:
+            continue
+        R = int(g_mask.sum())
+        packed_elems = C * mr * nc + R * n
+        if packed_elems > max_traffic_ratio * m * n:
+            continue
+        l_rows = np.full((C, mr), -1, np.int32)
+        l_cols = np.full((C, nc), -1, np.int32)
+        for i, (rl, cl) in enumerate(zip(row_lists, col_lists)):
+            l_rows[i, :len(rl)] = rl
+            l_cols[i, :len(cl)] = cl
+        return PackStructure(
+            g_rows=jnp.asarray(np.flatnonzero(g_mask).astype(np.int32)),
+            l_rows=jnp.asarray(l_rows), l_cols=jnp.asarray(l_cols))
+    return None
+
+
+@jax.jit
+def pack(structure: PackStructure, dense) -> Packed:
+    """Gather one dense (m, n) device matrix into packed form. Padded
+    index slots (-1) clamp to 0 for the gather and their values are
+    zeroed — position (0, c) holds real matrix data, which must not
+    leak into padding."""
+    lr = jnp.maximum(structure.l_rows, 0)
+    lc = jnp.maximum(structure.l_cols, 0)
+    vals = dense[lr[:, :, None], lc[:, None, :]]
+    mask = (structure.l_rows >= 0)[:, :, None] \
+        & (structure.l_cols >= 0)[:, None, :]
+    vals = jnp.where(mask, vals, 0)
+    return Packed(g_rows=structure.g_rows, g_vals=dense[structure.g_rows],
+                  l_rows=lr, l_cols=lc, l_vals=vals)
+
+
+def pk_Ax(pk: Packed, x, m):
+    """A x via the packed form: x (S, n) -> (S, m), single dtype."""
+    S = x.shape[0]
+    xg = x[:, pk.l_cols]                          # (S, C, nc)
+    loc = jnp.einsum("scn,cmn->scm", xg, pk.l_vals)
+    out = jnp.zeros((S, m), x.dtype)
+    out = out.at[:, pk.l_rows.reshape(-1)].add(loc.reshape(S, -1))
+    if pk.g_rows.size:
+        out = out.at[:, pk.g_rows].add(x @ pk.g_vals.T)
+    return out
+
+
+def pk_ATy(pk: Packed, y, n):
+    """Aᵀ y via the packed form: y (S, m) -> (S, n), single dtype."""
+    S = y.shape[0]
+    yg = y[:, pk.l_rows]                          # (S, C, mr)
+    loc = jnp.einsum("scm,cmn->scn", yg, pk.l_vals)
+    out = jnp.zeros((S, n), y.dtype)
+    out = out.at[:, pk.l_cols.reshape(-1)].add(loc.reshape(S, -1))
+    if pk.g_rows.size:
+        out = out + y[:, pk.g_rows] @ pk.g_vals
+    return out
+
+
+def pk_Ax_split(pk_hi: Packed, pk_lo: Packed, xh, xl, m):
+    """The df32 three-pass matvec (hi·xh + lo·xh + hi·xl, f64 accum —
+    the SplitMatrix contract) through the packed form. hi and lo share
+    one index skeleton, so x gathers once per operand and the three
+    f32 einsum results accumulate in f64 BEFORE a single scatter —
+    one f64 scatter instead of three f32 ones."""
+    S = xh.shape[0]
+    f64 = jnp.float64
+    xgh = xh[:, pk_hi.l_cols]
+    xgl = xl[:, pk_hi.l_cols]
+    loc = (jnp.einsum("scn,cmn->scm", xgh, pk_hi.l_vals).astype(f64)
+           + jnp.einsum("scn,cmn->scm", xgh, pk_lo.l_vals).astype(f64)
+           + jnp.einsum("scn,cmn->scm", xgl, pk_hi.l_vals).astype(f64))
+    out = jnp.zeros((S, m), f64)
+    out = out.at[:, pk_hi.l_rows.reshape(-1)].add(loc.reshape(S, -1))
+    if pk_hi.g_rows.size:
+        g = ((xh @ pk_hi.g_vals.T).astype(f64)
+             + (xh @ pk_lo.g_vals.T).astype(f64)
+             + (xl @ pk_hi.g_vals.T).astype(f64))
+        out = out.at[:, pk_hi.g_rows].add(g)
+    return out
+
+
+def pk_ATy_split(pk_hi: Packed, pk_lo: Packed, yh, yl, n):
+    """Transpose twin of pk_Ax_split."""
+    S = yh.shape[0]
+    f64 = jnp.float64
+    ygh = yh[:, pk_hi.l_rows]
+    ygl = yl[:, pk_hi.l_rows]
+    loc = (jnp.einsum("scm,cmn->scn", ygh, pk_hi.l_vals).astype(f64)
+           + jnp.einsum("scm,cmn->scn", ygh, pk_lo.l_vals).astype(f64)
+           + jnp.einsum("scm,cmn->scn", ygl, pk_hi.l_vals).astype(f64))
+    out = jnp.zeros((S, n), f64)
+    out = out.at[:, pk_hi.l_cols.reshape(-1)].add(loc.reshape(S, -1))
+    if pk_hi.g_rows.size:
+        g = ((yh[:, pk_hi.g_rows] @ pk_hi.g_vals).astype(f64)
+             + (yh[:, pk_hi.g_rows] @ pk_lo.g_vals).astype(f64)
+             + (yl[:, pk_hi.g_rows] @ pk_hi.g_vals).astype(f64))
+        out = out + g
+    return out

@@ -322,17 +322,23 @@ class OraclePool:
             rec = np.asarray(self._payload["integrality"], bool).copy()
             rec[idx] = False
             milp = bool(rec.any())
-        tasks = [(s, self.c[s].copy(), bool(milp), time_limit, mip_gap,
-                  False, (idx, xhat[s])) for s in range(self.S)]
+        # zero-probability rows (wheel padding: duplicates of real
+        # scenarios added to reuse compiled device shapes) contribute
+        # nothing to the expectation and duplicate a real row's
+        # feasibility check — skipping them is exact, not a shortcut
+        prob = np.asarray(prob, dtype=np.float64)
+        live = np.flatnonzero(prob > 0.0)
+        tasks = [(int(s), self.c[s].copy(), bool(milp), time_limit,
+                  mip_gap, False, (idx, xhat[s])) for s in live]
         results = self._run(tasks, kill_check)
         if results is None:
             return None
-        vals = np.full(self.S, np.nan)
+        vals = np.full(self.S, 0.0)
         for s, v, ok, is_opt, _ in results:
             if not (ok and is_opt):
                 return None
             vals[s] = v + self.c0[s]
-        return float(np.dot(np.asarray(prob, dtype=np.float64), vals))
+        return float(np.dot(prob, vals))
 
     def lagrangian_bound(self, prob, W=None, milp=False, time_limit=None,
                          mip_gap=None, kill_check=None):
@@ -340,14 +346,19 @@ class OraclePool:
         Lagrangian outer bound when sum_s p_s W_s = 0 per (node, slot)
         (the caller projects). None when any scenario solve failed or
         the kill check tripped."""
+        prob = np.asarray(prob, dtype=np.float64)
+        live = np.flatnonzero(prob > 0.0)
         res = self.scenario_values(W, milp=milp, time_limit=time_limit,
-                                   mip_gap=mip_gap, kill_check=kill_check)
+                                   mip_gap=mip_gap, kill_check=kill_check,
+                                   scenarios=live)
         if res is None:
             return None
         vals, ok, _ = res
-        if not ok.all():
+        # zero-probability padding rows are unsolved (-inf) by design;
+        # only the live rows carry the expectation
+        if not ok[live].all():
             return None
-        return float(np.dot(np.asarray(prob, dtype=np.float64), vals))
+        return float(np.dot(prob[live], vals[live]))
 
     def close(self):
         self._terminate_pool()

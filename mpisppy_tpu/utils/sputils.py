@@ -117,12 +117,17 @@ def _check_dict(d, keys, what):
                                "(ref. sputils.py:36-60 dict validation)")
 
 
-def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None):
+def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None,
+                   register_hub=None):
     """Run one hub + N spokes concurrently; returns a WheelResult.
 
     hub_dict:   {"hub_class", "hub_kwargs", "opt_class", "opt_kwargs"}
     spoke dict: {"spoke_class", "spoke_kwargs", "opt_class", "opt_kwargs"}
     (the reference's dict schema, ref. sputils.py:24-60)
+
+    ``register_hub``: optional callable invoked with the constructed
+    hub before the spin starts — lets a driver observe live progress
+    (gap marks) from a signal handler when it may be killed mid-spin.
     """
     _check_dict(hub_dict, ("hub_class", "opt_class"), "hub_dict")
     for sd in list_of_spoke_dicts:
@@ -139,6 +144,8 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None):
                                 **hub_dict.get("hub_kwargs", {}))
     hub.make_windows()
     hub.setup_hub()
+    if register_hub is not None:
+        register_hub(hub)
 
     spoke_errors: list[BaseException | None] = [None] * len(spokes)
 

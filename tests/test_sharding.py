@@ -95,3 +95,60 @@ def test_chunked_solve_matches_fused_under_mesh():
     ph_s.solve_loop(w_on=True, prox_on=True)
     np.testing.assert_allclose(np.asarray(ph_c.xbar),
                                np.asarray(ph_s.xbar), atol=5e-4)
+
+
+@pytest.mark.slow
+def test_multistep_chunked_df32_parity_uc():
+    """VERDICT r4 #7: >=5 chunked df32 PH iterations on the mesh must
+    track the single-device trajectory (xbar/W/conv) on a UC model
+    with min-up/down + ramping (+ the r5 T0/start-stop-ramp families).
+    One-step parity (above) misses multi-iteration drift — flowed
+    factor handoffs, blacklists, per-chunk rho trajectories — which is
+    where sharded state bugs live."""
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.models import uc
+
+    def mk():
+        return build_batch(
+            uc.scenario_creator, uc.make_tree(16),
+            creator_kwargs={"num_gens": 6, "num_hours": 6,
+                            "relax_integrality": False,
+                            "min_up_down": True, "ramping": True,
+                            "t0_state": True,
+                            "startup_shutdown_ramps": True},
+            vector_patch=uc.scenario_vector_patch)
+
+    opts = {"defaultPHrho": 100.0, "subproblem_precision": "df32",
+            "subproblem_max_iter": 400, "subproblem_eps": 1e-5,
+            "subproblem_eps_hot": 1e-4, "subproblem_eps_dua_hot": 1e-2,
+            "subproblem_stall_rel": 1.5e-3, "subproblem_tail_iter": 150,
+            "subproblem_segment": 150, "subproblem_segment_lo": 400,
+            "subproblem_polish_hot": False, "subproblem_hospital": False,
+            "subproblem_chunk": 8}
+
+    def run(mesh):
+        ph = PHBase(mk(), dict(opts), mesh=mesh,
+                    dtype=jax.numpy.float64)
+        traj = []
+        ph.solve_loop(w_on=False, prox_on=False)
+        ph.W = ph.W_new
+        for _ in range(5):
+            ph.solve_loop(w_on=True, prox_on=True)
+            ph.W = ph.W_new
+            traj.append((np.asarray(ph.xbar[:16]).copy(),
+                         np.asarray(ph.W[:16]).copy(), float(ph.conv)))
+        return traj
+
+    t_single = run(None)
+    t_mesh = run(make_mesh())
+    for k, ((xb0, W0, c0), (xb1, W1, c1)) in enumerate(
+            zip(t_single, t_mesh)):
+        # different XLA partitions reorder reductions; the iterative
+        # trajectories diverge by O(solve tolerance) per iteration,
+        # compounding across the 5 steps — bands widen with k
+        tol = 2e-3 * (k + 1)
+        np.testing.assert_allclose(xb0, xb1, atol=tol,
+                                   err_msg=f"xbar diverged at iter {k}")
+        np.testing.assert_allclose(W0, W1, atol=100.0 * tol,
+                                   err_msg=f"W diverged at iter {k}")
+        assert c1 == pytest.approx(c0, abs=tol), f"conv at iter {k}"
