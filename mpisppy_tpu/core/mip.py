@@ -174,7 +174,7 @@ def _dive_once(factors, data, q, state, imask, round_offset,
 
 def dive_integers(factors, data, q, c0, state, integer_mask,
                   max_iter=2000, eps=1e-7, int_tol=1e-5, feas_tol=1e-4,
-                  max_rounds=None, polish_chunk=0):
+                  max_rounds=None, polish_chunk=0, pin_frac=8):
     """Drive all scenarios to integer feasibility on ``integer_mask``.
 
     Returns (x, obj, feasible, state):
@@ -211,7 +211,8 @@ def dive_integers(factors, data, q, c0, state, integer_mask,
     off = np.full((S,), 0.5)
     x, st, lb, ub, pinned = _dive_once(factors, data, q, state, imask, off,
                                        max_iter, eps, int_tol, rounds,
-                                       polish_chunk, feas_tol=feas_tol)
+                                       polish_chunk, pin_frac=pin_frac,
+                                       feas_tol=feas_tol)
     feasible = check(x, st)
 
     if not bool(jnp.all(feasible)):
@@ -242,7 +243,8 @@ def dive_integers(factors, data, q, c0, state, integer_mask,
         # only the unpinned columns dive; all other pins ride in lb2/ub2
         x2, st2, *_ = _dive_once(factors, d2, q, st, jnp.asarray(unpin),
                                  off2, max_iter, eps, int_tol, rounds,
-                                 polish_chunk, feas_tol=feas_tol)
+                                 polish_chunk, pin_frac=pin_frac,
+                                 feas_tol=feas_tol)
         feas2 = check(x2, st2)
         take = (~feasible & feas2)[:, None]
         x = jnp.where(take, x2, x)
@@ -254,7 +256,8 @@ def dive_integers(factors, data, q, c0, state, integer_mask,
         off3 = np.where(np.asarray(feasible), 0.5, 1.0 - 1e-9)
         x3, st3, *_ = _dive_once(factors, data, q, state, imask, off3,
                                  max_iter, eps, int_tol, rounds,
-                                 polish_chunk, feas_tol=feas_tol)
+                                 polish_chunk, pin_frac=pin_frac,
+                                 feas_tol=feas_tol)
         feas3 = check(x3, st3)
         take = (~feasible & feas3)[:, None]
         x = jnp.where(take, x3, x)

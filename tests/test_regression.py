@@ -186,5 +186,6 @@ def test_bench_uc10_padded_wheel_smoke():
                         xhat_oracle_time_limit=20.0))
     res2 = spin_the_wheel(hd, sds)
     assert np.isfinite(res2.best_outer_bound)
+    assert np.isfinite(res2.best_inner_bound)
     assert res2.best_outer_bound <= res2.best_inner_bound * (1 + 1e-6) \
         + 1e-6
