@@ -255,6 +255,27 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
         return filled
 
     def main(self):
+        # PRE-HUB first pass (r5): with oracle candidates as the sole
+        # source (dives off), every candidate is hub-independent —
+        # per-scenario MILP plans + the union fallback use no hub
+        # nonants — so the first incumbent can be built and exactly
+        # evaluated WHILE the hub compiles/solves iter0 instead of
+        # after its first publish. On the reference-scale uc10 wheel
+        # the time-to-gap IS the first-incumbent time (the exact-LP
+        # outer bound is tight from the prep pass), so this overlap is
+        # worth ~a hub iteration + the MILP wall directly off the
+        # crossing time.
+        if self.options.get("xhat_oracle_candidates", False) \
+                and not self.options.get("xhat_dive_candidates", True) \
+                and self.options.get("xhat_union_fallback", False) \
+                and bool(np.asarray(self.opt.nonant_integer_mask).any()):
+            # union fallback required: without it, rows beyond the
+            # oracle's scenario limit hold the all-zeros placeholder
+            # and the shuffle's first pick could burn a full evaluation
+            # on a zero plan — the opposite of the overlap this buys
+            X0 = np.zeros((self.opt.batch.S, self.opt.batch.K))
+            self._last_try = time.monotonic()
+            self.try_candidates(self._prepare_candidates(X0))
         while not self.got_kill_signal():
             if time.monotonic() - self._last_try < self._min_interval:
                 # let the hub keep the device stream — and leave the

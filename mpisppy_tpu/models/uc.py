@@ -96,10 +96,21 @@ def t0_fleet_state(num_gens: int, seed: int = 4321):
     return on0, spent, p0
 
 
+def quick_start_set(num_gens: int):
+    """The quick-start generator subset (the reference data files'
+    ``QuickStart`` parameter, ref. examples/uc/2013-05-11/
+    Scenario_1.dat): the smallest/fastest ~20% of the fleet — peakers
+    that can be brought online within the hour, so their capacity
+    counts toward spinning reserve even when not committed."""
+    frac = np.linspace(0.0, 1.0, num_gens)
+    return frac >= 0.8
+
+
 def scenario_creator(scenario_name, num_gens=10, num_hours=24,
                      relax_integrality=True, min_up_down=False,
                      ramping=False, t0_state=False,
-                     startup_shutdown_ramps=False) -> Model:
+                     startup_shutdown_ramps=False,
+                     quick_start=False) -> Model:
     """``min_up_down`` adds the Rajan–Takriti turn-on inequalities
     (sum of startups in a UT_g window <= u, and in a DT_g window <=
     1 - u shifted) and ``ramping`` adds second-stage dispatch ramp rows
@@ -192,12 +203,22 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
                 rhs_su[gt(g, 0)] = -1.0
     m.constr(st - (Su @ u) >= rhs_su, name="startup_def")
 
-    # reserve: sum_g Pmax_g u_gt >= (1+r)load_t - wind_t
+    # reserve: sum_g Pmax_g u_gt >= (1+r)load_t - wind_t. With
+    # ``quick_start``, the quick-start subset's capacity counts toward
+    # reserve regardless of commitment (they can come online within
+    # the hour — the reference's QuickStart parameter semantics,
+    # ref. examples/uc/2013-05-11/Scenario_1.dat); their constant
+    # contribution moves to the rhs
+    qs = quick_start_set(G) if quick_start else np.zeros(G, bool)
     Ru = np.zeros((T, G * T))
     for g in range(G):
+        if qs[g]:
+            continue
         for t in range(T):
             Ru[t, gt(g, t)] = fl["pmax"][g]
-    m.constr((Ru @ u) >= (1.0 + RESERVE_FRAC) * load - wind, name="reserve")
+    qs_cap = float(fl["pmax"][qs].sum())
+    m.constr((Ru @ u) >= (1.0 + RESERVE_FRAC) * load - wind - qs_cap,
+             name="reserve")
 
     if min_up_down:
         # Rajan–Takriti window inequalities on the startup indicators:
@@ -328,7 +349,8 @@ def scenario_creator(scenario_name, num_gens=10, num_hours=24,
 def scenario_vector_patch(scenario_name, num_gens=10, num_hours=24,
                           relax_integrality=True, min_up_down=False,
                           ramping=False, t0_state=False,
-                          startup_shutdown_ramps=False):
+                          startup_shutdown_ramps=False,
+                          quick_start=False):
     """Structure-shared fast path for build_batch(vector_patch=...): the
     ONLY scenario-dependent data in a UC scenario is the wind trace,
     which enters the balance rhs, the reserve rhs, and the spill upper
@@ -342,9 +364,14 @@ def scenario_vector_patch(scenario_name, num_gens=10, num_hours=24,
     scennum = int(re.search(r"(\d+)$", scenario_name).group(1))
     load = load_profile(num_hours, num_gens)
     wind = wind_scenario(scennum, num_hours, num_gens)
+    rhs_reserve = (1.0 + RESERVE_FRAC) * load - wind
+    if quick_start:
+        fl = fleet(num_gens)
+        rhs_reserve = rhs_reserve \
+            - float(fl["pmax"][quick_start_set(num_gens)].sum())
     return {("l", "balance"): load - wind,
             ("u", "balance"): load - wind,
-            ("l", "reserve"): (1.0 + RESERVE_FRAC) * load - wind,
+            ("l", "reserve"): rhs_reserve,
             ("ub", "spill"): np.maximum(wind, 0.0)}
 
 

@@ -286,3 +286,55 @@ def test_uc_t0_state_and_su_sd_ramps():
         obj = ph.solve_loop(w_on=False, prox_on=False)
         objs.append(float(np.asarray(ph.Eobjective(obj))))
     assert abs(objs[1] - objs[0]) > 1e-6 * abs(objs[0])
+
+
+def test_uc_quick_start_set():
+    """quick_start: the QS subset's capacity serves reserve without
+    commitment (reference QuickStart parameter) — reserve rows lose
+    their u coefficients, the rhs shifts by the QS capacity, and the
+    relaxed reserve makes the optimum no more expensive."""
+    from mpisppy_tpu.models import uc as ucm
+
+    G, T = 8, 10
+    base_kw = dict(num_gens=G, num_hours=T, relax_integrality=True,
+                   min_up_down=True, ramping=True)
+    b0 = build_batch(ucm.scenario_creator, ucm.make_tree(2),
+                     creator_kwargs=base_kw,
+                     vector_patch=ucm.scenario_vector_patch)
+    bq = build_batch(ucm.scenario_creator, ucm.make_tree(2),
+                     creator_kwargs=dict(base_kw, quick_start=True),
+                     vector_patch=ucm.scenario_vector_patch)
+    qs = ucm.quick_start_set(G)
+    assert qs.any() and not qs.all()
+    Aq = np.asarray(bq.A if bq.A.ndim == 2 else bq.A[0])
+    sl = bq.template.con_slices["reserve"]
+    fl = ucm.fleet(G)
+    for g in range(G):
+        cols = slice(g * T, (g + 1) * T)
+        coeffs = Aq[sl, cols]
+        if qs[g]:
+            assert np.all(coeffs == 0.0)
+        else:
+            assert np.allclose(np.diag(coeffs[:T, :T]), fl["pmax"][g])
+    qs_cap = float(fl["pmax"][qs].sum())
+    np.testing.assert_allclose(np.asarray(bq.l)[0][sl],
+                               np.asarray(b0.l)[0][sl] - qs_cap)
+    # economics on scipy ground truth (ADMM objectives at the residual
+    # floor are too loose for an inequality this tight): relaxing
+    # reserve can only cheapen scenario 0's LP
+    from scipy.optimize import linprog
+
+    def truth(b):
+        A = np.asarray(b.A if b.A.ndim == 2 else b.A[0])
+        u_s, l_s = np.asarray(b.u)[0], np.asarray(b.l)[0]
+        fin_u, fin_l = np.isfinite(u_s), np.isfinite(l_s)
+        lp = linprog(np.asarray(b.c)[0],
+                     A_ub=np.vstack([A[fin_u], -A[fin_l]]),
+                     b_ub=np.concatenate([u_s[fin_u], -l_s[fin_l]]),
+                     bounds=list(zip(np.asarray(b.lb)[0],
+                                     np.asarray(b.ub)[0])),
+                     method="highs")
+        assert lp.status == 0
+        return lp.fun + float(np.asarray(b.c0)[0])
+
+    assert truth(bq) <= truth(b0) + 1e-9 * abs(truth(b0))
