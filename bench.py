@@ -431,11 +431,11 @@ def _warm_gap_programs(batch, tag):
     from mpisppy_tpu.core.ph import PHBase
 
     chunk_kw = {"subproblem_chunk": 128} if batch.S > 128 else {}
-    # max_iter cut for speed (warmups exist to trigger compiles);
-    # tail/segment INHERIT from DF32 so the compiled program shapes
-    # stay locked to the wheel configs across retunes
-    ph = PHBase(batch, dict(DF32, iter0_feas_tol=5e-3,
-                            subproblem_max_iter=200, **chunk_kw),
+    # budgets INHERIT from DF32 wholesale so the compiled program
+    # shapes stay locked to the wheel configs across retunes (a
+    # max_iter override would be a no-op anyway: the f32 bulk runs
+    # whole segment_lo-sized segments)
+    ph = PHBase(batch, dict(DF32, iter0_feas_tol=5e-3, **chunk_kw),
                 dtype=jax.numpy.float64)
     _progress(f"gap warmup {tag}: iter0")
     ph.solve_loop(w_on=False, prox_on=False)
@@ -528,12 +528,13 @@ def bench_uc1024_gap():
         batch, "uc1024", baseline_s=0.0, max_iterations=28,
         xhat_extra=dict(_XHAT_ORACLE, xhat_min_interval=60.0),
         warm=False,   # bench_1024 just ran the same programs
-        note="the north-star scale (ref. paperruns/larger_uc/"
-             "1000scenarios_wind, SLURM targets 64 ranks + Gurobi; no "
-             "published wall time exists, so vs_baseline is 0 by "
+        note="the north-star scale (ref. paperruns/larger_uc/quartz/"
+             "1000scen_fw: SLURM -N 256, srun -n 4000 ranks of "
+             "gurobi_persistent under a 10-minute wall budget; no "
+             "checked-in result log exists, so vs_baseline is 0 by "
              "construction) — measured outer/inner gap trajectory at "
-             "S=1024; exact host-LP bound passes are ~5 min each on "
-             "this 1-core host")
+             "S=1024 on ONE chip + one host core; exact host-LP bound "
+             "passes are ~5 min each here")
 
 
 _HEADROOM_PROBE = """
