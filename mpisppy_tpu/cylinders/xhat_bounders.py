@@ -66,6 +66,12 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
         """Yield (K,) or (S,K) candidate nonant blocks from hub nonants X."""
         raise NotImplementedError
 
+    def needs_prepare(self):
+        """Whether the NEXT candidates() turn reads the prepared block
+        — consensus turns (XhatShuffleInnerBound) don't, and skipping
+        _prepare_candidates there saves its oracle MILP wall."""
+        return True
+
     def try_candidates(self, X):
         for xhat in self.candidates(X):
             if self.killed():
@@ -75,11 +81,18 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
                 # kill window and their finalize was dropped)
                 return
             # skip candidates already evaluated (the hub often re-pushes
-            # near-identical nonants; a full batched solve buys nothing)
+            # near-identical nonants, and alternating candidate sources
+            # re-present unchanged blocks; a full batched/host solve
+            # buys nothing) — a small ring, not one slot, so A-B-A
+            # alternation still dedups
             key = np.asarray(self.opt.round_nonants(xhat)).tobytes()
-            if key == getattr(self, "_last_key", None):
+            seen = getattr(self, "_seen_keys", None)
+            if seen is None:
+                from collections import deque
+                seen = self._seen_keys = deque(maxlen=8)
+            if key in seen:
                 continue
-            self._last_key = key
+            seen.append(key)
             exact_on = self.options.get("xhat_exact_eval", False)
             # ``xhat_device_prescreen``: gate candidates through the
             # batched device evaluation before paying the host oracle.
@@ -150,6 +163,28 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
             if self._oracle_pool is None:
                 self._oracle_pool = False
             return "unavailable", None
+
+    def _stash_consensus(self, X):
+        """``xhat_consensus_candidates``: build one candidate by
+        THRESHOLD-rounding the probability-weighted consensus of the
+        hub's nonant block — commit every pinned binary the fleet runs
+        at >= ``xhat_consensus_threshold`` (default 0.3) in the mean.
+        Per-scenario MILP plans are optimal for their own realization
+        and their union over-commits; the consensus candidate sits
+        between them (classic UC consensus rounding), with the exact
+        evaluator as the feasibility/quality gate. Yielded every other
+        pass by the shuffle looper. No-op without a pin mask."""
+        if not self.options.get("xhat_consensus_candidates", False) \
+                or self._pin_mask is None:
+            return
+        tau = float(self.options.get("xhat_consensus_threshold", 0.3))
+        prob = np.asarray(self.opt.prob, dtype=np.float64)
+        w = prob / max(prob.sum(), 1e-300)
+        cons = w @ np.asarray(X, dtype=np.float64)        # (K,)
+        cand = cons.copy()
+        pm = self._pin_mask
+        cand[pm] = np.where(cons[pm] >= tau, 1.0, 0.0)
+        self._consensus_cand = cand
 
     def _prepare_candidates(self, X):
         """On integer-nonant models, replace the hub's fractional nonant
@@ -287,7 +322,12 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
                 continue
             self._last_try = time.monotonic()
             _, X = self.unpack_hub(values)
-            self.try_candidates(self._prepare_candidates(X))
+            # consensus snapshot from the RAW hub block (prepare
+            # replaces rows with oracle/dive plans; the fractional
+            # consensus is only visible here)
+            self._stash_consensus(X)
+            self.try_candidates(self._prepare_candidates(X)
+                                if self.needs_prepare() else X)
 
     def finalize(self):
         """Return (bound, best_xhat) (ref. xhatshufflelooper_bounder.py:198
@@ -311,9 +351,27 @@ class XhatShuffleInnerBound(_XhatInnerBound):
         rng = np.random.RandomState(self.options.get("xhat_seed", 42))
         self._order = rng.permutation(S)        # ref. :108-111 seed 42
         self._pos = 0                           # ScenarioCycler resume point
+        self._consensus_turn = False
+
+    def needs_prepare(self):
+        # candidates() flips _consensus_turn then yields: the NEXT turn
+        # is a consensus turn iff the flag is currently False and a
+        # consensus candidate exists — the prepared block would be
+        # discarded unread
+        return not (not self._consensus_turn
+                    and getattr(self, "_consensus_cand", None) is not None)
 
     def candidates(self, X):
-        # one candidate per fresh-nonant pass; epoch wraps around
+        # one candidate per fresh-nonant pass; epoch wraps around.
+        # With xhat_consensus_candidates, alternate between the
+        # consensus-rounded candidate (see _stash_consensus) and the
+        # scenario cycle — try_candidates' dedup skips a repeat
+        # consensus cheaply when the hub barely moved.
+        self._consensus_turn = not self._consensus_turn
+        cons = getattr(self, "_consensus_cand", None)
+        if self._consensus_turn and cons is not None:
+            yield cons
+            return
         s = int(self._order[self._pos])
         self._pos = (self._pos + 1) % len(self._order)
         yield X[s]

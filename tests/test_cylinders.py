@@ -179,3 +179,46 @@ def test_base_receive_does_not_consume_cut_windows():
     # ...so the subclass still consumes it
     hub.receive_bounds()
     assert hub._spoke_last_ids[ci] > 0
+
+
+def test_consensus_candidate_mechanism():
+    """xhat_consensus_candidates: the spoke builds one candidate by
+    threshold-rounding the probability-weighted consensus of the RAW
+    hub nonant block (commit every pinned binary at >= tau in the
+    mean), and the shuffle looper alternates it with the scenario
+    cycle."""
+    import numpy as np
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.cylinders.xhat_bounders import XhatShuffleInnerBound
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.models import uc
+
+    batch = build_batch(
+        uc.scenario_creator, uc.make_tree(4),
+        creator_kwargs=dict(num_gens=6, num_hours=6,
+                            relax_integrality=False, min_up_down=True),
+        vector_patch=uc.scenario_vector_patch)
+    ph = PHBase(batch, {"defaultPHrho": 10.0})
+    sp = XhatShuffleInnerBound(ph, options={
+        "xhat_consensus_candidates": True,
+        "xhat_consensus_threshold": 0.3,
+        "xhat_pin_vars": ["u"]})
+    S, K = batch.S, batch.K
+    rng = np.random.RandomState(7)
+    X = rng.rand(S, K)
+    sp._stash_consensus(X)
+    cand = sp._consensus_cand
+    assert cand is not None and cand.shape == (K,)
+    cons = X.mean(axis=0)        # uniform probabilities
+    pm = sp._pin_mask
+    np.testing.assert_array_equal(cand[pm],
+                                  (cons[pm] >= 0.3).astype(float))
+    # unpinned (derived) slots keep the consensus value
+    np.testing.assert_allclose(cand[~pm], cons[~pm])
+    # alternation: consensus first, then a scenario row, then consensus
+    c1 = next(iter(sp.candidates(X)))
+    np.testing.assert_array_equal(c1, cand)
+    c2 = next(iter(sp.candidates(X)))
+    assert any(np.array_equal(c2, X[s]) for s in range(S))
+    c3 = next(iter(sp.candidates(X)))
+    np.testing.assert_array_equal(c3, cand)
