@@ -326,25 +326,42 @@ _XHAT_ORACLE = {
 }
 
 _ACTIVE_WHEEL = {"hub": None, "t0": None, "prefix": None, "baseline": 0.0}
+_KILLED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_partial_killed.json")
 
 
 def _flush_active_wheel(signum=None, frame=None):
-    """SIGTERM mid-spin (driver timeout): emit DNF rows carrying any
+    """SIGTERM mid-spin (driver timeout): record DNF rows carrying any
     crossed gap marks before dying — a killed phase must still leave
-    its trajectory evidence (VERDICT r4 #8)."""
+    its trajectory evidence (VERDICT r4 #8). SIGNAL-SAFE (ADVICE r5):
+    the handler only READS hub marks and writes a SEPARATE
+    BENCH_partial_killed.json — it never touches _EMITTED or
+    BENCH_partial.json, so a kill landing mid-emit cannot corrupt the
+    partials file at exactly the moment the evidence matters."""
     hub = _ACTIVE_WHEEL["hub"]
     if hub is not None:
-        _emit_gap_rows(_ACTIVE_WHEEL["prefix"], hub.gap_mark_times,
-                       _ACTIVE_WHEEL["t0"], time.perf_counter(),
-                       _ACTIVE_WHEEL["baseline"],
-                       note="KILLED mid-spin (driver timeout); marks "
-                            "crossed before the kill are real", rel=None)
+        rows = _gap_rows(_ACTIVE_WHEEL["prefix"], hub,
+                         _ACTIVE_WHEEL["t0"], time.perf_counter(),
+                         _ACTIVE_WHEEL["baseline"],
+                         note="KILLED mid-spin (driver timeout); marks "
+                              "crossed before the kill are real", rel=None)
+        try:
+            with open(_KILLED_PATH + ".tmp", "w") as f:
+                json.dump(rows, f, indent=1)
+            os.replace(_KILLED_PATH + ".tmp", _KILLED_PATH)
+        except Exception:
+            pass   # dying anyway; partials on disk stay uncorrupted
     if signum is not None:
         sys.exit(124)
 
 
-def _emit_gap_rows(prefix, marks, t0, t_end, baseline_s, note, rel):
+def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
+    """Build (don't emit) the gap metric rows for one wheel — shared by
+    the normal emit path and the SIGTERM flush, which must not touch
+    the partials file (see _flush_active_wheel)."""
+    marks = hub.gap_mark_times
     tail = "" if rel is None else f"final gap {100 * rel:.3f}%, "
+    rows = []
     for mark, name in ((0.01, f"{prefix}_time_to_1pct_gap_seconds"),
                        (0.005, f"{prefix}_time_to_halfpct_gap_seconds")):
         reached = marks.get(mark)
@@ -356,13 +373,33 @@ def _emit_gap_rows(prefix, marks, t0, t_end, baseline_s, note, rel):
             t_gap = round(t_end - t0, 1)
             vs = 0.0
             metric = name.replace("_seconds", "_DNF_wall_seconds")
-        emit({
+        rows.append({
             "metric": metric,
             "value": t_gap,
             "unit": f"s to rel gap <= {100 * mark:g}% ({tail}"
                     f"{INSTANCE_STR}; {note})",
             "vs_baseline": vs,
         })
+    # the moment the outer bound first beat the iter-0 trivial seed —
+    # the acceptance evidence that the device-dual bounder publishes a
+    # non-trivial certified bound early, not only at the end
+    fnt = hub.first_nontrivial_outer_time() \
+        if hasattr(hub, "first_nontrivial_outer_time") else None
+    if fnt is not None:
+        rows.append({
+            "metric": f"{prefix}_first_nontrivial_outer_bound_seconds",
+            "value": round(fnt - t0, 1),
+            "unit": "s from spin start to the first certified outer "
+                    "bound strictly above the iter-0 trivial bound "
+                    f"({note})",
+            "vs_baseline": 0.0,
+        })
+    return rows
+
+
+def _emit_gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
+    for row in _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
+        emit(row)
 
 
 def _wheel(batch, lag_device_bound=False, hub_extra=None, lag_extra=None,
@@ -448,7 +485,7 @@ def _warm_gap_programs(batch, tag):
 
 def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
                    note, rel_gap=0.004, lag_device_bound=False,
-                   xhat_extra=None, warm=True):
+                   xhat_extra=None, lag_extra=None, warm=True):
     from mpisppy_tpu.utils.sputils import spin_the_wheel
 
     if warm:
@@ -456,7 +493,7 @@ def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
     _progress(f"{metric_prefix}: building wheel (S={batch.S})")
     hd, sds = _wheel(batch, lag_device_bound=lag_device_bound,
                      max_iterations=max_iterations, rel_gap=rel_gap,
-                     xhat_extra=xhat_extra)
+                     xhat_extra=xhat_extra, lag_extra=lag_extra)
     _progress(f"{metric_prefix}: spinning")
     t0 = time.perf_counter()
     try:
@@ -471,8 +508,8 @@ def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
     _, rel = res.gap()
     note_full = (f"outer {res.best_outer_bound:.1f}, inner "
                  f"{res.best_inner_bound:.1f}; " + note)
-    _emit_gap_rows(metric_prefix, res.hub.gap_mark_times, t0, t_end,
-                   baseline_s, note_full, rel)
+    _emit_gap_rows(metric_prefix, res.hub, t0, t_end, baseline_s,
+                   note_full, rel)
 
 
 def bench_uc10_gap():
@@ -511,21 +548,32 @@ def bench_uc10_gap_device_bound():
     _run_gap_wheel(
         batch, "uc10_device_bound", baseline_s=31.59, max_iterations=25,
         lag_device_bound=True, warm=False,
+        lag_extra={"lagrangian_device_duals": True},
         xhat_extra=dict(_XHAT_ORACLE, xhat_min_interval=5.0),
         note="DEVICE-CERTIFIED outer bound: the df32 engine's own dual "
-             "certificate (prox-off solves, qp_dual_objective floor), "
-             "no host LP oracle in the bound loop; incumbents stay "
+             "certificate (prox-off solves, device dual repair + host "
+             "f64 safe-rounding certification, utils/certify), no host "
+             "LP oracle in the bound loop; incumbents stay "
              "host-exact-evaluated (a true upper bound needs exact "
              "feasibility)")
 
 
 def bench_uc1024_gap():
     batch = big_batch(1024)
-    # 28 iterations: run 1 ended at its 20-iteration cap at 0.646%
-    # still falling — the second exact-LP refresh (~5 min host) needs
-    # the extra headroom to land the 0.5% mark
+    # RE-SEQUENCED (r6): the outer bound no longer waits on the ~5-min
+    # exact host-LP pass — the Lagrangian spoke runs in DEVICE-DUAL
+    # mode (duals extracted from the chunked packed-df32 prox-off
+    # solve, repaired on device, certified on host in f64 with
+    # safe-rounding margins), so a non-trivial certified bound lands
+    # within the first hub sync (~one chunked solve pass, well inside
+    # the first 120 s) and the exact-LP pass runs as an ASYNC tightener
+    # whose value is harvested whenever it completes. r5 recorded
+    # uc1024_time_to_1pct_gap_DNF with the bound pinned at the trivial
+    # row for the whole 841 s spin because two exact passes in a row
+    # were starved by the driver kill.
     _run_gap_wheel(
         batch, "uc1024", baseline_s=0.0, max_iterations=28,
+        lag_extra={"lagrangian_device_duals": True},
         # consensus-rounded candidates alternate with the oracle
         # plans: the union-of-MILP-plans incumbent over-commits, and
         # the halfpct mark plateaued 0.15% above it in every r5 run —
@@ -539,8 +587,8 @@ def bench_uc1024_gap():
              "gurobi_persistent under a 10-minute wall budget; no "
              "checked-in result log exists, so vs_baseline is 0 by "
              "construction) — measured outer/inner gap trajectory at "
-             "S=1024 on ONE chip + one host core; exact host-LP bound "
-             "passes are ~5 min each here")
+             "S=1024 on ONE chip + one host core; device-dual certified "
+             "outer bounds every sync + async exact-LP tightener")
 
 
 _HEADROOM_PROBE = """
@@ -596,13 +644,18 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     enable_honest_f32()
     signal.signal(signal.SIGTERM, _flush_active_wheel)
-    # clear a previous run's partials BEFORE any phase: a run that dies
-    # pre-first-emit must leave an empty file, not inherit stale rows
+    # clear a previous run's partials AND killed-rows file BEFORE any
+    # phase: a run that dies pre-first-emit must leave empty artifacts,
+    # not inherit stale rows (a prior run's kill evidence included)
     # that would read as this run's evidence
     _EMITTED.clear()
     with open(_PARTIAL_PATH + ".tmp", "w") as f:
         json.dump([], f)
     os.replace(_PARTIAL_PATH + ".tmp", _PARTIAL_PATH)
+    try:
+        os.remove(_KILLED_PATH)
+    except FileNotFoundError:
+        pass
     _wait_for_headroom()
 
     # (phase fn, minimum sensible wall budget to enter it)

@@ -320,7 +320,7 @@ class PHBase(SPBase):
         self.x = None            # (S, n) latest subproblem solutions
         self.conv = None
         self._iter = 0
-        self.best_bound = -jnp.inf  # outer (lower, for min) bound
+        self.best_bound = -float("inf")  # outer (lower, for min) bound
 
         self._factors = {}       # prox_on -> QPFactors
         self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
@@ -782,7 +782,7 @@ class PHBase(SPBase):
             xbar_new, xsqbar_new, W_new, conv = _ph_combine(
                 cat["xn"], self.prob, self.xbar_weights,
                 tuple(self.memberships), self.W, self.rho, wmask,
-                slot_slices=tuple(self.slot_slices))
+                slot_slices=self.slot_bounds)
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
             self.conv = float(conv)
@@ -959,7 +959,7 @@ class PHBase(SPBase):
             self.nonant_idx, self.W, self.xbar, self.rho,
             self._fixed_mask, self._fixed_vals, self._w_scale,
             w_on=bool(w_on), prox_on=bool(prox_on),
-            slot_slices=tuple(self.slot_slices),
+            slot_slices=self.slot_bounds,
             sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
             polish_chunk=int(self.options.get("subproblem_polish_chunk",
                                               0)),
@@ -1062,6 +1062,22 @@ class PHBase(SPBase):
         would produce an invalid outer bound. Meaningful for prox-off
         solves (trivial bound, Lagrangian spokes)."""
         return float(self.Eobjective(self._last_dual_obj))
+
+    def update_best_bound(self, bound):
+        """Monotone best-outer-bound bookkeeping: accept an incremental
+        improvement from ANY source — the engine's own Ebound, a
+        device-dual bounder spoke, or the exact host oracle harvested
+        through the hub — and ignore everything else. Returns True when
+        the best bound moved. This is the engine-side half of the
+        hub/spoke incremental-bound contract (the hub's
+        OuterBoundUpdate is the wheel-side half)."""
+        if bound is None:
+            return False
+        b = float(bound)
+        if np.isfinite(b) and b > self.best_bound:
+            self.best_bound = b
+            return True
+        return False
 
     def Eobjective_value(self):
         return float(self.Eobjective(self._last_base_obj))
@@ -1271,7 +1287,7 @@ class PH(PHBase):
         if not warm:
             self.Update_W()  # W was zero, so W = rho(x - xbar)
         self.trivial_bound = self.Ebound()  # certified wait-and-see bound
-        self.best_bound = self.trivial_bound
+        self.update_best_bound(self.trivial_bound)
         self._iter = 0
         self._ext("post_iter0")
         if self.converger_cls is not None:
@@ -1286,6 +1302,8 @@ class PH(PHBase):
             # solver-bound startup; with asynchronous host bound spokes
             # a whole wheel can be within tolerance before iter 1.
             self.spcomm.sync()
+            self.update_best_bound(
+                getattr(self.spcomm, "BestOuterBound", None))
             if self.spcomm.is_converged():
                 global_toc("PH iter 0: hub termination", self.verbose)
                 if finalize:
@@ -1300,6 +1318,10 @@ class PH(PHBase):
             self._ext("miditer")
             if self.spcomm is not None:
                 self.spcomm.sync()
+                # incremental best-bound bookkeeping: spoke bounds
+                # (device-dual or exact-oracle) flow back to the engine
+                self.update_best_bound(
+                    getattr(self.spcomm, "BestOuterBound", None))
                 if self.spcomm.is_converged():
                     global_toc(f"PH iter {it}: hub termination", self.verbose)
                     break

@@ -117,6 +117,12 @@ def compute_xbar(memberships, slot_slices, weights, xn):
     SPBase.compute_xbar wraps it."""
     outs = []
     for B, sl in zip(memberships, slot_slices):
+        # slot ranges may arrive as (start, stop) int pairs: Python
+        # slice objects are unhashable before 3.12, so jitted steps
+        # that take the ranges as STATIC arguments (core/ph._ph_reduce)
+        # must pass the hashable spelling (SPBase.slot_bounds)
+        if isinstance(sl, tuple):
+            sl = slice(*sl)
         xt = xn[:, sl]
         if weights.ndim == 2:
             w = weights[:, sl]
@@ -273,6 +279,10 @@ class SPBase:
         self.memberships = [jnp.asarray(b.tree.membership(s + 1), t)
                             for s in range(b.tree.num_stages - 1)]
         self.slot_slices = b.stage_slot_slices
+        # hashable twin of slot_slices for static jit arguments (slice
+        # is unhashable before Python 3.12; see compute_xbar)
+        self.slot_bounds = tuple((sl.start, sl.stop)
+                                 for sl in b.stage_slot_slices)
 
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec

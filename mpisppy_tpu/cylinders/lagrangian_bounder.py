@@ -12,6 +12,7 @@ takes the hub's *nonants* instead and computes its own x̄ and W locally
 
 from __future__ import annotations
 
+import threading
 import time
 
 import jax.numpy as jnp
@@ -19,16 +20,88 @@ import numpy as np
 
 from .spoke import OuterBoundWSpoke, OuterBoundNonantSpoke
 
+_UNSET = object()
+
+
+class _AsyncRefresh:
+    """One in-flight background bound refresh at a time, newest-wins
+    queueing: ``launch(arg)`` starts ``fn(arg)`` on a daemon thread when
+    idle (or parks ``arg`` as the pending argument when busy — only the
+    newest pending argument survives), ``poll()`` harvests a finished
+    result (or None) and auto-relaunches on the pending argument.
+
+    This is what DEMOTES the exact host-LP oracle from the bound loop's
+    bottleneck to an asynchronous tightener: the spoke keeps publishing
+    cheap device-certified bounds every sync while a ~minutes-long exact
+    refresh runs here, and harvests the exact value whenever it lands.
+    ``fn`` must be kill-aware (the oracle pool's kill_check) — the wheel
+    terminating mid-refresh abandons the thread harmlessly (daemon)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._lock = threading.Lock()
+        self._thread = None
+        self._result = _UNSET
+        self._pending = _UNSET
+
+    @property
+    def busy(self):
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _start(self, arg):
+        def run():
+            out = self._fn(arg)
+            with self._lock:
+                self._result = out
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def launch(self, arg):
+        with self._lock:
+            if self.busy:
+                self._pending = arg
+            else:
+                self._start(arg)
+
+    def poll(self):
+        """Finished result (may be None for a failed refresh) or None."""
+        with self._lock:
+            out = self._result
+            self._result = _UNSET
+            if not self.busy and self._pending is not _UNSET:
+                arg, self._pending = self._pending, _UNSET
+                self._start(arg)
+        return None if out is _UNSET else out
+
 
 class LagrangianOuterBound(OuterBoundWSpoke):
-    """Three bound engines, composable by options:
+    """Four bound engines, composable by options:
 
     - default: the batched on-device solve + certified dual bound
       (valid at ANY solve accuracy, tight once duals converge);
-    - ``lagrangian_exact_oracle``: per-scenario host HiGHS LPs
-      (utils/host_oracle) — exact L(W) of the LP relaxation, the analog
-      of the reference's spoke renting a CPU simplex per scenario (ref.
-      lagrangian_bounder.py:5-87). Fast (~10 ms/scenario) but floored
+    - ``lagrangian_device_duals``: the DEVICE-DUAL mode — the primary
+      bound source becomes the engine's own dual iterates from the
+      (chunked, packed-df32) prox-off solve, pulled f32 (quantized
+      duals are still exact duals), repaired onto the dual-feasible
+      cone and certified on host in f64 with directed-rounding margins
+      (utils/certify.DualBoundCertifier; the repair is the host twin
+      of ops/qp_solver.qp_repair_duals), so every published value is
+      provably <= the true optimum WITHOUT an LP oracle call. Bounds
+      publish early-and-often: one at prep (W=0, seconds after the
+      first solve pass) and one per hub sync. When
+      ``lagrangian_exact_oracle`` is also on (and the MIP oracle off —
+      a MIP bound dominates the LP bound at equal W), the exact
+      host-LP pass is DEMOTED to an asynchronous tightener/cross-check
+      (_AsyncRefresh): it runs on the newest projected W in the
+      background and its exact value is harvested whenever it lands —
+      minutes-long host passes stop gating the wheel's first certified
+      bound.
+    - ``lagrangian_exact_oracle`` (without device duals): per-scenario
+      host HiGHS LPs (utils/host_oracle), blocking — exact L(W) of the
+      LP relaxation, the analog of the reference's spoke renting a CPU
+      simplex per scenario (ref. lagrangian_bounder.py:5-87). Floored
       at the instance's LP integrality gap.
     - ``lagrangian_mip_oracle``: per-scenario host HiGHS **MILPs** with
       W on — the true Lagrangian dual function, matching the
@@ -41,9 +114,10 @@ class LagrangianOuterBound(OuterBoundWSpoke):
       through a subprocess pool that overlaps the hub's device work and
       aborts on the hub's kill signal mid-refresh.
 
-    Linear objectives only for both oracles; quadratic models and
-    variable-probability runs fall back to the certified device bound.
-    The spoke is asynchronous, so host latency never blocks the hub.
+    Linear objectives only for the oracles and the host certification;
+    quadratic models and variable-probability runs fall back to the
+    certified device bound. The spoke is asynchronous, so host latency
+    never blocks the hub.
     """
     converger_spoke_char = "L"
 
@@ -58,6 +132,15 @@ class LagrangianOuterBound(OuterBoundWSpoke):
                                             False)) and self._linear
         self._mip = bool(self.options.get("lagrangian_mip_oracle",
                                           False)) and self._linear
+        # device-dual mode (see class docstring): engine duals as the
+        # primary bound source, host-certified; exact oracle demoted to
+        # an asynchronous tightener
+        self._device_duals = bool(self.options.get(
+            "lagrangian_device_duals", False))
+        self._certify = bool(self.options.get("lagrangian_certify_host",
+                                              True)) and self._linear
+        self._certifier = None          # lazy DualBoundCertifier | False
+        self._tightener = None          # lazy _AsyncRefresh
         self._mip_tl = float(self.options.get("lagrangian_mip_time_limit",
                                               10.0))
         self._mip_gap = float(self.options.get("lagrangian_mip_gap", 1e-4))
@@ -73,28 +156,42 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         # very large batches can disable it.
         self._warm = bool(self.options.get("lagrangian_lp_ef_warmstart",
                                            True)) \
-            and (self._exact or self._mip)
+            and (self._exact or self._mip) and not self._device_duals
         self._pool = None
+        self._pool_lock = threading.Lock()
+        self._oracle_use_lock = threading.Lock()
         self._projector = None
         self._last_mip_at = -float("inf")
         self._last_mip_ok = True
 
     def _oracle(self):
-        if self._pool is None:
-            from ..utils.host_oracle import OraclePool
-            self._pool = OraclePool(
-                self.opt.batch,
-                n_workers=self.options.get("lagrangian_oracle_workers"))
-        return self._pool
+        # construction is locked: the async tightener thread and the
+        # spoke's own MIP refresh may race on first use
+        with self._pool_lock:
+            if self._pool is None:
+                from ..utils.host_oracle import OraclePool
+                self._pool = OraclePool(
+                    self.opt.batch,
+                    n_workers=self.options.get("lagrangian_oracle_workers"))
+            return self._pool
 
     def _oracle_bound(self, W=None, **kw):
         """Oracle call with the spoke's failure contract: ANY oracle
         problem (worker subprocess death included) degrades to None so
         the caller falls back to the device bound — a bound spoke must
-        never crash the wheel over a host solver hiccup."""
+        never crash the wheel over a host solver hiccup.
+
+        Pool USE is serialized under _oracle_use_lock: the async
+        exact-LP tightener thread and the spoke thread's own MIP
+        refresh share one worker pool, and OraclePool._run is a
+        single-caller protocol (two concurrent callers would interleave
+        task/result frames on the same worker pipes and cross-deliver
+        values computed at different W). The tightener blocking here is
+        harmless — it is the background thread."""
         try:
-            return self._oracle().lagrangian_bound(
-                self.opt.batch.prob, W, kill_check=self.killed, **kw)
+            with self._oracle_use_lock:
+                return self._oracle().lagrangian_bound(
+                    self.opt.batch.prob, W, kill_check=self.killed, **kw)
         except Exception:
             return None
 
@@ -121,13 +218,105 @@ class LagrangianOuterBound(OuterBoundWSpoke):
             self._projector = make_w_projector(self.opt.batch)
         return self._projector(W_flat)
 
+    # -- device-dual mode (the certified-without-an-oracle path) --
+    def _host_certified(self, W):
+        """Host f64 safe-rounding certification of the engine's current
+        row duals (utils/certify). Returns the certified bound, or None
+        when certification is unavailable/uncertifiable — callers fall
+        back to the device Ebound value."""
+        if not self._certify or self._certifier is False:
+            return None
+        if self._certifier is None:
+            try:
+                from ..utils.certify import DualBoundCertifier
+                self._certifier = DualBoundCertifier.from_batch(
+                    self.opt.batch)
+            except Exception as e:
+                # construction failure (ineligible layout, host OOM on
+                # the sparse build) is permanent for this batch: latch
+                # off, but SAY SO — the published bounds silently
+                # degrading from host-certified to device-certified
+                # must be visible in the trace
+                from .. import global_toc
+                global_toc(f"{type(self).__name__}: host certification "
+                           f"unavailable ({e!r}); publishing the device "
+                           "dual certificate instead")
+                self._certifier = False
+                return None
+        try:
+            # f32 transfer: quantized duals are still exact duals —
+            # validity is free, the tightness cost is ~1e-7 relative,
+            # and the (S, m) device→host pull halves (tens of MB at
+            # uc1024 scale on tunneled links). The cone repair happens
+            # host-side inside the certifier (its _sanitize is the
+            # same projection ops/qp_solver.qp_repair_duals runs on
+            # device — one repair suffices).
+            yA = np.asarray(jnp.asarray(self.opt.yA, jnp.float32),
+                            np.float64)
+            b, _ = self._certifier.bound(
+                yA, None if W is None else np.asarray(W, np.float64))
+            return b if np.isfinite(b) else None
+        except Exception as e:
+            # evaluation failure may be TRANSIENT (host memory spike at
+            # uc1024 scale): log, fall back to the device certificate
+            # for THIS refresh, and retry on the next one — do not
+            # latch certification off over one hiccup
+            if not getattr(self, "_warned_cert_fail", False):
+                self._warned_cert_fail = True
+                from .. import global_toc
+                global_toc(f"{type(self).__name__}: host certification "
+                           f"failed this refresh ({e!r}); falling back "
+                           "to the device dual certificate (will keep "
+                           "retrying)")
+            return None
+
+    def _device_bound(self, W):
+        """Certified outer bound from the engine's OWN duals at W (None
+        = W off): one batched prox-off solve, dual extraction from the
+        chunked/packed solve path, host f64 certification when
+        eligible, device dual-objective certificate otherwise."""
+        opt = self.opt
+        if W is None:
+            opt.solve_loop(w_on=False, prox_on=False, update=False)
+        else:
+            opt.W = jnp.asarray(W, opt.dtype)
+            opt.solve_loop(w_on=True, prox_on=False, update=False)
+        dev = opt.Ebound()
+        cert = self._host_certified(W)
+        return dev if cert is None else cert
+
+    def _ensure_tightener(self):
+        if self._tightener is None:
+            def refresh(W):
+                return self._oracle_bound(W, time_limit=self._lp_tl)
+
+            self._tightener = _AsyncRefresh(refresh)
+        return self._tightener
+
     def lagrangian_prep(self):
         """Bound before any W arrives (ref. lagrangian_bounder.py:20-52
         computes the trivial W=0 bound here). With the LP-EF warm start
         the prep bound is the LP-relaxation OPTIMUM (its dual W* is the
         LP-Lagrangian maximizer), and the MIP oracle refreshed at W*
         immediately lands near the full Lagrangian dual — the W=0
-        trivial bound is strictly dominated and skipped."""
+        trivial bound is strictly dominated and skipped.
+
+        In device-dual mode the prep bound comes from the engine's own
+        first prox-off pass instead (seconds, not the minutes a
+        reference-scale exact-LP pass costs on a 1-core host), and the
+        exact oracle — when configured — starts as an asynchronous
+        tightener at W=0 immediately, so its exact value lands during
+        the first hub iterations rather than gating them."""
+        if self._device_duals:
+            self.update_bound(self._device_bound(None))
+            if self._exact and not self._mip:
+                # the exact-LP tightener only exists when the MIP
+                # oracle is off: at equal W the MIP bound dominates the
+                # LP bound, and one shared worker pool cannot serve a
+                # minutes-long background LP pass AND the cadence-fired
+                # MIP refresh without starving one of them
+                self._ensure_tightener().launch(None)
+            return
         if self._warm:
             try:
                 from ..utils.host_oracle import solve_lp_ef
@@ -178,11 +367,37 @@ class LagrangianOuterBound(OuterBoundWSpoke):
     def main(self):
         self.lagrangian_prep()
         while not self.got_kill_signal():
+            if self._tightener is not None:
+                # harvest a finished async exact-LP refresh (device-dual
+                # mode); a failed refresh returns None and publishes
+                # nothing — the device bounds keep flowing regardless
+                tightened = self._tightener.poll()
+                if tightened is not None:
+                    self.update_bound(tightened)
             fresh, values = self.spoke_from_hub()
             if not fresh or values is None:
                 continue
             W, _ = self.unpack_hub(values)
             W = self._project_W(W)
+            if self._device_duals:
+                # primary: the engine's own certified duals at the
+                # newest W — published every sync, seconds each
+                self.update_bound(self._device_bound(W))
+                if self._exact and not self._mip:
+                    # newest-wins: the async exact pass always runs on
+                    # the freshest projected W (LP tightener only when
+                    # the MIP oracle is off — see lagrangian_prep)
+                    self._ensure_tightener().launch(np.asarray(W))
+                if self._mip and (time.monotonic() - self._last_mip_at
+                                  >= self._mip_cadence):
+                    # cadence-fired MIP refresh, blocking like the
+                    # legacy path (users enabling the MIP oracle accept
+                    # its wall); device bounds keep flowing between
+                    # refreshes
+                    bound = self._mip_refresh(W)
+                    if bound is not None:
+                        self.update_bound(bound)
+                continue
             if not (self._mip and self._mip_cadence == 0.0
                     and self._last_mip_ok):
                 # with back-to-back SUCCEEDING MIP refreshes the LP
@@ -200,6 +415,9 @@ class LagrangianOuterBound(OuterBoundWSpoke):
                     self.update_bound(bound)
 
     def finalize(self):
+        # closing the pool EOFs any in-flight async tightener's worker
+        # reads; its daemon thread then exits through the oracle's
+        # failure contract (None result, never raised into the wheel)
         if self._pool is not None:
             self._pool.close()
         return super().finalize()

@@ -353,24 +353,39 @@ class XhatShuffleInnerBound(_XhatInnerBound):
         self._pos = 0                           # ScenarioCycler resume point
         self._consensus_turn = False
 
+    def _consensus_fresh(self):
+        """A consensus candidate exists AND its dedup key is not in the
+        recent-key ring — i.e. yielding it would actually be evaluated.
+        A stale consensus turn must fall through to the scenario cycle
+        in the SAME pass (ADVICE r5): returning after a dedup hit
+        wasted every other pass while the hub plateaued."""
+        cons = getattr(self, "_consensus_cand", None)
+        if cons is None:
+            return False
+        seen = getattr(self, "_seen_keys", None)
+        if seen is None:
+            return True
+        return np.asarray(self.opt.round_nonants(cons)).tobytes() \
+            not in seen
+
     def needs_prepare(self):
         # candidates() flips _consensus_turn then yields: the NEXT turn
-        # is a consensus turn iff the flag is currently False and a
-        # consensus candidate exists — the prepared block would be
-        # discarded unread
-        return not (not self._consensus_turn
-                    and getattr(self, "_consensus_cand", None) is not None)
+        # consumes the consensus candidate (skipping the prepared
+        # block) iff the flag is currently False and a FRESH consensus
+        # exists — a stale one falls through to the scenario cycle,
+        # which does read the prepared block
+        return not (not self._consensus_turn and self._consensus_fresh())
 
     def candidates(self, X):
         # one candidate per fresh-nonant pass; epoch wraps around.
         # With xhat_consensus_candidates, alternate between the
         # consensus-rounded candidate (see _stash_consensus) and the
-        # scenario cycle — try_candidates' dedup skips a repeat
-        # consensus cheaply when the hub barely moved.
+        # scenario cycle; a consensus already in the dedup ring (hub
+        # barely moved) falls through to the scenario cycle so the
+        # pass still evaluates something (ADVICE r5).
         self._consensus_turn = not self._consensus_turn
-        cons = getattr(self, "_consensus_cand", None)
-        if self._consensus_turn and cons is not None:
-            yield cons
+        if self._consensus_turn and self._consensus_fresh():
+            yield self._consensus_cand
             return
         s = int(self._order[self._pos])
         self._pos = (self._pos + 1) % len(self._order)

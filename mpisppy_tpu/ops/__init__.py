@@ -1,3 +1,3 @@
 from .qp_solver import (QPData, QPFactors, QPState, qp_setup, qp_solve,  # noqa: F401
                         qp_cold_state, qp_objective, qp_dual_objective,
-                        benders_cut)
+                        qp_repair_duals, qp_state_duals, benders_cut)

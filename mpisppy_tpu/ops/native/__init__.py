@@ -20,7 +20,15 @@ _lib = None
 
 
 def _build():
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC]
+    import sys
+
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO,
+           _SRC]
+    if sys.platform.startswith("linux"):
+        # shm_open/shm_unlink live in librt on pre-2.34 glibc (the flag
+        # is harmless where they moved into libc); macOS has no librt
+        # and keeps them in libc, so the flag must stay Linux-only
+        cmd.append("-lrt")
     subprocess.run(cmd, check=True, capture_output=True)
 
 
@@ -33,7 +41,14 @@ def load():
         if (not os.path.exists(_SO)
                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
             _build()
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # a prebuilt .so from a different toolchain (e.g. missing
+            # the librt link, surfacing as "undefined symbol:
+            # shm_open") — rebuild in place for THIS toolchain
+            _build()
+            lib = ctypes.CDLL(_SO)
         lib.spw_create.restype = ctypes.c_void_p
         lib.spw_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         lib.spw_open.restype = ctypes.c_void_p

@@ -1518,6 +1518,35 @@ def qp_objective(data: QPData, q, c0, x):
         + jnp.sum(q * x, axis=-1) + c0
 
 
+@jax.jit
+def qp_state_duals(factors: QPFactors, state: QPState):
+    """UNSCALED (yA, yB) dual iterates straight from a warm solver
+    state — the dual-extraction entry for bound consumers that want
+    the current iterates WITHOUT another solve call (e.g. a bounder
+    publishing between warm-started passes). The unscaling is the one
+    _solve_impl applies to its return values; any dual vector yields a
+    valid bound via qp_dual_objective, so mid-trajectory iterates are
+    legitimate (if loose) bound sources."""
+    cs = factors.cost_scale
+    shared = factors.A_s.ndim == 2
+    csx = cs if shared else cs[:, None]
+    return (factors.E / csx) * state.yA, (factors.Eb / csx) * state.yB
+
+
+@jax.jit
+def qp_repair_duals(l, u, lb, ub, yA, yB):
+    """Project unscaled duals onto the dual-feasible cone: zero every
+    component pushing on an infinite bound (always sign-infeasible
+    there). This is a *choice of a different valid dual vector*, not an
+    approximation — the repaired pair certifies a bound wherever the
+    raw pair would certify −inf. Run it on device BEFORE pulling duals
+    to host for certification (utils/certify): the repaired arrays
+    compress losslessly to f32 for the transfer (quantized duals are
+    still exact duals)."""
+    return (_sanitize_row_duals(l, u, yA),
+            _sanitize_row_duals(lb, ub, yB))
+
+
 def _boxmin(P, r, lb, ub):
     """Coordinate-wise min of ½P x² + r x over [lb, ub] (P >= 0 diagonal).
     Returns -inf where a linear piece descends toward an infinite bound."""

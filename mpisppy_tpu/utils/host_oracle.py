@@ -333,11 +333,22 @@ class OraclePool:
         results = self._run(tasks, kill_check)
         if results is None:
             return None
-        vals = np.full(self.S, 0.0)
+        # poison-not-zero (ADVICE r5): live rows start NaN so a result
+        # that silently never lands cannot enter the probability dot
+        # product as a free 0.0 objective; padding (p=0) rows stay 0
+        vals = np.zeros(self.S)
+        vals[live] = np.nan
         for s, v, ok, is_opt, _ in results:
             if not (ok and is_opt):
                 return None
             vals[s] = v + self.c0[s]
+        if not np.isfinite(vals[live]).all():
+            # a live row missing from the results (should be impossible
+            # through _run, but a certified inner bound must not ride
+            # on "should be"): refuse to publish rather than let a NaN
+            # or zero placeholder enter the expectation. A plain check,
+            # not an assert — the guard must survive python -O.
+            return None
         return float(np.dot(prob, vals))
 
     def lagrangian_bound(self, prob, W=None, milp=False, time_limit=None,

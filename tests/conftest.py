@@ -8,9 +8,28 @@ JAX_PLATFORMS env var is ignored, so the platform must be forced through
 jax.config before any computation runs.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+try:
+    # jax >= 0.5 spelling of the virtual-device count
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax 0.4.x: the XLA flag is the only route. Setting it here is in
+    # time — XLA reads it at backend initialization (first device use),
+    # which happens after conftest import. Never set BOTH: jax >= 0.5
+    # rejects the combination at backend init.
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = \
+            (os.environ.get("XLA_FLAGS", "")
+             + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def pytest_configure(config):
@@ -20,7 +39,3 @@ def pytest_configure(config):
     # suite the nightly one (< 10 min)
     config.addinivalue_line(
         "markers", "slow: long-running tier (full-suite runs only)")
-jax.config.update("jax_num_cpu_devices", 8)
-jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -6,7 +6,8 @@ import pytest
 from scipy.optimize import linprog
 
 from mpisppy_tpu.ops.qp_solver import (
-    QPData, qp_setup, qp_solve, qp_cold_state, qp_objective)
+    QPData, qp_setup, qp_solve, qp_cold_state, qp_objective,
+    qp_dual_objective, qp_repair_duals)
 
 
 def _solve_batch(P, A, l, u, lb, ub, q, max_iter=20000, **kw):
@@ -115,6 +116,35 @@ def test_warm_start_reuses_factor():
     for s in range(S):
         ref = linprog(q1[s], A_ub=A[s], b_ub=b[s], bounds=[(0, 5)] * n)
         assert q1[s] @ x1[s] == pytest.approx(ref.fun, rel=1e-4, abs=1e-4)
+
+
+def test_repaired_dual_objective_bounds_optimum():
+    """qp_dual_objective of cone-repaired duals is a valid lower bound
+    on LPs with one-sided rows and half-open variable boxes — the
+    shapes whose wrong-sign dual drift would otherwise certify -inf."""
+    rng = np.random.RandomState(5)
+    S, n, m = 4, 6, 4
+    A = rng.randn(S, m, n)
+    b = rng.rand(S, m) * 5 + 1.0
+    q = rng.rand(S, n) + 0.1          # positive costs: x >= 0 is bounded
+    P = np.zeros((S, n))
+    l = np.full((S, m), -np.inf)
+    lb = np.zeros((S, n))
+    ub = np.full((S, n), np.inf)      # half-open boxes
+    data = QPData(*map(jnp.asarray, (P, A, l, b, lb, ub)))
+    factors = qp_setup(data, q_ref=jnp.asarray(q))
+    st = qp_cold_state(factors, data)
+    st, x, yA, yB = qp_solve(factors, data, jnp.asarray(q), st,
+                             max_iter=20000)
+    yA_r, yB_r = qp_repair_duals(data.l, data.u, data.lb, data.ub, yA, yB)
+    dvals = np.asarray(qp_dual_objective(data, jnp.asarray(q), 0.0,
+                                         yA_r, yB_r, x_witness=x))
+    for s in range(S):
+        ref = linprog(q[s], A_ub=A[s], b_ub=b[s],
+                      bounds=[(0, None)] * n)
+        assert ref.status == 0
+        assert dvals[s] <= ref.fun + 1e-6
+        assert dvals[s] >= ref.fun - 1e-3 * (1.0 + abs(ref.fun))
 
 
 def test_duals_match_scipy():
