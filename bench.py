@@ -268,7 +268,8 @@ def bench_1024():
         ph.W = ph.W_new
     jax.block_until_ready(ph.x)
     _progress("uc1024: timing 2 iterations")
-    total_iters = 0
+    ph.reset_phase_timing()   # warmup iterations must not dilute the
+    total_iters = 0           # per-phase anatomy of the timed window
     t0 = time.perf_counter()
     for _ in range(2):
         ph.solve_loop(w_on=True, prox_on=True)
@@ -284,11 +285,27 @@ def bench_1024():
     pri_rel = float(np.asarray(ph._qp_states[True].pri_rel).max())
     flops = total_iters * _flops_per_admm_iter_dense_equiv(chunk)
     mfu = flops / dt / V5E_PEAK_BF16
+    # pipelined-dispatch anatomy (ISSUE 2): where the PH iteration
+    # budget goes (assemble/solve/gate/reduce), the device-busy
+    # occupancy, and the acceptance evidence that quality-gate D2H
+    # syncs are O(1) per iteration, not O(chunks)
+    pt = ph.phase_timing(True) or {}
+    per_call = pt.get("seconds_per_call", {})
+    # packed operand footprint: bytes one split A-pass (hi+lo pair)
+    # streams — the hot loop's bandwidth-bound cost basis (see
+    # ops/packed.pk_nbytes / doc/roofline.md)
+    A = getattr(ph.qp_data.A, "A_s", ph.qp_data.A)   # ScaledView -> split
+    pk_mb = None
+    if getattr(A, "pk_hi", None) is not None:
+        from mpisppy_tpu.ops.packed import pk_nbytes
+        pk_mb = round((pk_nbytes(A.pk_hi) + pk_nbytes(A.pk_lo)) / 1e6, 2)
     emit({
         "metric": "uc1024_ph_seconds_per_iteration",
         "value": round(sec_per_iter, 3),
         "unit": "s/PH-iter (1024 scenarios, 1 chip, structure-packed "
-                "df32 kernel via 128-scenario microbatching — max "
+                "df32 kernel via 128-scenario microbatching, pipelined "
+                "chunk dispatch (pre-assembled chunks + fused "
+                "residual gate + donated warm starts) — max "
                 f"pri_rel {pri_rel:.1e}; {INSTANCE_STR}; baseline 165 "
                 "s/iter EXTRAPOLATED scenario-proportionally from the "
                 "Quartz 10-scen trend, no checked-in 1000-scen log; mfu "
@@ -298,7 +315,17 @@ def bench_1024():
         "vs_baseline": round(165.0 / sec_per_iter, 2),
         "mfu": round(mfu, 4),
         "achieved_tflops_dense_equiv": round(flops / dt / 1e12, 1),
+        "pipeline_occupancy": round(pt.get("occupancy", 0.0), 4),
+        "phase_seconds_per_iter": {
+            k: round(v, 3) for k, v in per_call.items()},
+        "gate_d2h_syncs_per_iter": pt.get("gate_d2h_syncs_per_call"),
+        "spread_devices": pt.get("devices", 1),
+        "packed_matvec_mbytes_per_pass": pk_mb,
     })
+    _progress(f"uc1024: pipeline occupancy "
+              f"{pt.get('occupancy', 0.0):.3f} (device-busy fraction), "
+              f"phases/iter {per_call}, "
+              f"gate syncs/iter {pt.get('gate_d2h_syncs_per_call')}")
     del ph
 
 

@@ -38,7 +38,7 @@ from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import (QPData, QPState, qp_setup, qp_solve,
                              qp_solve_mixed, qp_solve_segmented,
                              qp_cold_state, qp_dual_objective,
-                             qp_reset_rho)
+                             qp_reset_rho, stacked_residuals)
 from .spbase import SPBase, compute_xbar
 
 
@@ -133,7 +133,7 @@ def _hot_eps(prox_on, sub_eps, sub_eps_hot):
 def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                  sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
                  tail_iter, stall_rel, segment, polish_hot, polish_chunk,
-                 segment_lo=None, ir_sweeps=1):
+                 segment_lo=None, ir_sweeps=1, donate=False):
     """The ONE precision-policy + solver dispatch, shared by the fused
     step and the chunked loop (a second copy would silently drift).
 
@@ -165,14 +165,14 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
                               stall_rel=stall_rel, segment=segment,
                               segment_lo=segment_lo, polish=do_polish,
-                              ir_sweeps=ir_sweeps)
+                              ir_sweeps=ir_sweeps, donate=donate)
     return qp_solve_segmented(factors, d, q, qp_state,
                               max_iter=sub_max_iter, segment=segment,
                               eps_abs=e_pri, eps_rel=e_pri,
                               polish_chunk=polish_chunk,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
                               stall_rel=stall_rel, polish=do_polish,
-                              ir_sweeps=ir_sweeps)
+                              ir_sweeps=ir_sweeps, donate=donate)
 
 
 def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
@@ -236,9 +236,13 @@ class _ChunkStateView:
 
     def __getattr__(self, name):
         if name in _ChunkStateView._FIELDS:
-            val = jnp.concatenate(
+            from ..parallel.mesh import colocate
+            # multi-device chunk spreading leaves each chunk's state
+            # committed to its round-robin device; concatenation needs
+            # one placement, so colocate onto the first chunk's device
+            val = jnp.concatenate(colocate(
                 [getattr(s, name)[:r]
-                 for s, r in zip(self._states, self._trims)])
+                 for s, r in zip(self._states, self._trims)]))
             setattr(self, name, val)
             return val
         raise AttributeError(name)
@@ -343,6 +347,21 @@ class PHBase(SPBase):
         # serialize host work behind device compute)
         self._timing = bool(opts.get("display_timing", False))
         self._solve_times = {}
+        # pipelined chunk dispatch (see _solve_loop_chunked): per-mode
+        # donation eligibility (a key enters after its first completed
+        # pass — before that, chunk states share cold-state buffers and
+        # donating one chunk's would delete its siblings'), the
+        # per-device replication cache for chunk spreading, and the
+        # per-phase wall-clock/sync accounting the bench and tests read
+        self._chunk_donatable = set()
+        # modes whose donating pass is in flight: set before pass 1
+        # consumes the warm-start buffers, cleared once pass 3 stores
+        # their successors — a crash in between leaves the cached
+        # states referencing DELETED arrays, and the next call must
+        # rebuild cold instead of warm-starting from them
+        self._chunk_dirty = set()
+        self._spread_cache = {}
+        self._phase_times = {}
 
     # ------------- solver plumbing -------------
     def _data_with_prox(self, prox_on: bool) -> QPData:
@@ -461,6 +480,15 @@ class PHBase(SPBase):
         self._chunk_no_retry.clear()
         self._hospital_no_retry.clear()
         self._blacklist_calls.clear()
+        # chunk-plumbing caches ride the factor lifetime: rebuilt chunk
+        # states start from shared cold buffers again (donation must
+        # re-earn eligibility), spread replicas hold the OLD factors,
+        # and the index cache — keyed by (chunk, S) so a mutated batch
+        # can never silently reuse stale slices — resets with them
+        self._chunk_donatable.clear()
+        self._chunk_dirty.clear()
+        self._spread_cache.clear()
+        getattr(self, "_chunk_idx_cache", {}).clear()
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
@@ -470,6 +498,7 @@ class PHBase(SPBase):
         ``_replace`` contract — materialize it (fresh factor, the view's
         iterates as warm start) before handing it out."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+        self._drop_if_dirty(key)
         st = self._qp_states.get(key)
         if isinstance(st, _ChunkStateView):
             factors, d = self._get_factors(prox_on, fixed)
@@ -481,7 +510,7 @@ class PHBase(SPBase):
             factors, d = self._get_factors(prox_on, fixed)
             st = qp_cold_state(factors, d)
             other = next((v for k, v in self._qp_states.items()
-                          if k != key
+                          if k != key and k not in self._chunk_dirty
                           and isinstance(v, (QPState, _ChunkStateView))),
                          None)
             if other is not None and other.x.shape == st.x.shape \
@@ -492,6 +521,19 @@ class PHBase(SPBase):
                                  zA=other.zA, zB=other.zB)
             self._qp_states[key] = st
         return self._qp_states[key]
+
+    def _drop_if_dirty(self, key):
+        """A previous DONATING chunked pass of ``key`` died between
+        consuming its warm-start buffers (pass 1) and storing their
+        successors (pass 3): every cached state/view of that mode
+        references DELETED arrays. Drop them so any consumer — the
+        mode's own re-run, another mode's warm-start transplant, a
+        view reader — rebuilds cold instead of crashing."""
+        if key in self._chunk_dirty:
+            self._qp_states.pop(("chunks", key), None)
+            self._qp_states.pop(key, None)
+            self._chunk_dirty.discard(key)
+            self._chunk_donatable.discard(key)
 
     # ------------- scenario microbatching -------------
     def _chunk_index(self, chunk):
@@ -504,7 +546,9 @@ class PHBase(SPBase):
         S = self.batch.S
         if not hasattr(self, "_chunk_idx_cache"):
             self._chunk_idx_cache = {}
-        if chunk not in self._chunk_idx_cache:
+        # keyed by (chunk, S): an entry keyed by chunk alone would
+        # silently survive batch mutation and re-target wrong scenarios
+        if (chunk, S) not in self._chunk_idx_cache:
             out = []
             for i in range(0, S, chunk):
                 idx = np.arange(i, min(i + chunk, S))
@@ -513,8 +557,8 @@ class PHBase(SPBase):
                     idx = np.concatenate(
                         [idx, np.full(chunk - real, idx[-1])])
                 out.append((jnp.asarray(idx), real))
-            self._chunk_idx_cache[chunk] = out
-        return self._chunk_idx_cache[chunk]
+            self._chunk_idx_cache[(chunk, S)] = out
+        return self._chunk_idx_cache[(chunk, S)]
 
     def _ensure_chunk_states(self, key, factors, data, slices):
         """Per-chunk QPStates (each owns its L / rho_scale trajectory —
@@ -529,7 +573,7 @@ class PHBase(SPBase):
         ck = ("chunks", key)
         if ck not in self._qp_states:
             other = next((v for k, v in self._qp_states.items()
-                          if k != ck
+                          if k != ck and k not in self._chunk_dirty
                           and isinstance(v, (QPState, _ChunkStateView))),
                          None)
             states = []
@@ -554,6 +598,60 @@ class PHBase(SPBase):
             self._qp_states[ck] = states
         return self._qp_states[ck]
 
+    def _spread_devices_for(self, split_mode):
+        """Devices for round-robin chunk spreading, or None. Engages
+        when the engine holds a >1-device mesh (the MULTICHIP shape) or
+        when ``subproblem_spread_devices=<n>`` asks for n local devices
+        explicitly; split (df32) mode never spreads — its chunks FLOW
+        one factor sequentially (see pass 1) and a per-device factor
+        per chunk is exactly the HBM multiplication the flow avoids."""
+        if split_mode:
+            return None
+        opt = self.options.get("subproblem_spread_devices", "auto")
+        if opt in (0, "0", None, False):
+            return None
+        if self.mesh is not None:
+            from ..parallel.mesh import spread_devices
+            return spread_devices(self.mesh)
+        if opt == "auto":
+            # meshless engines stay single-device unless explicitly
+            # asked: every local process (tests run 8 virtual CPU
+            # devices) silently fanning out would multiply compile
+            # count and HBM residency without anyone opting in
+            return None
+        devs = jax.devices()[:int(opt)]
+        return devs if len(devs) > 1 else None
+
+    def _spread_replicas(self, key, factors, data, devices):
+        """Per-device copies of the shared solve operands (factors +
+        shared A / P) for chunk spreading, cached per mode until
+        invalidate_factors. Replication is the price of the data
+        parallelism: each device holds the full shared matrix, exactly
+        like every MPI rank of the reference holds its scenarios'
+        models."""
+        from ..parallel.mesh import put_chunk
+        ck = ("spread", key)
+        ent = self._spread_cache.get(ck)
+        if ent is None or ent[0] is not factors:
+            reps = {dev: (put_chunk(factors, dev), put_chunk(data.A, dev),
+                          put_chunk(data.P_diag, dev))
+                    for dev in devices}
+            ent = (factors, reps)
+            self._spread_cache[ck] = ent
+        return ent[1]
+
+    def _home_put(self, tree):
+        """Return a pytree committed to the engine's HOME placement —
+        replicated over the mesh when one exists (so spread-solve
+        outputs can mix with GSPMD-sharded reduction inputs), the
+        default device otherwise."""
+        if self.mesh is not None:
+            from ..parallel.mesh import replicated_sharding
+            return jax.tree.map(
+                lambda a: jax.device_put(
+                    a, replicated_sharding(self.mesh, a.ndim)), tree)
+        return jax.device_put(tree, jax.devices()[0])
+
     def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed):
         """Host-looped scenario microbatching: S scenarios solved in
         ceil(S/chunk) shared-factor kernel calls, then one global
@@ -563,7 +661,29 @@ class PHBase(SPBase):
         stable at <=128 scenarios per device call on current TPU
         runtimes, while the cross-scenario reductions are cheap at any
         S. Requires shared structure (one A / P across scenarios — the
-        representation that makes single-factor chunking exact)."""
+        representation that makes single-factor chunking exact).
+
+        PIPELINED DISPATCH (default; ``subproblem_pipeline=0`` opts
+        back into the plain sequential loop for debugging): the loop is
+        staged so host work and device solves overlap instead of
+        strictly alternating —
+         - ASSEMBLE: every chunk's (q, bounds) is enqueued up front, so
+           per-chunk host assembly cost hides behind device compute
+           instead of sitting on the critical path before each solve;
+         - SOLVE: non-split chunks round-robin across devices when a
+           >1-device mesh is available (waves of ~ceil(chunks/n_dev)
+           concurrent solves, each driven by its own host thread with
+           explicit device_put placement); split (df32) chunks keep the
+           sequential factor flow and overlap assembly only. Warm-start
+           states are DONATED to the solver after the first pass (see
+           qp_solver._qp_solve_jit_donated) so per-segment factor
+           copies alias instead of duplicating;
+         - GATE: the recovery/hospital decisions read ONE stacked
+           residual matrix — a single D2H transfer per PH iteration
+           instead of one blocking sync per chunk.
+        Per-phase wall-clock and sync counts land in
+        ``phase_timing()`` for the bench/profiling observability."""
+        import time as _time
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         factors, data = self._get_factors(prox_on, fixed)
         if factors.A_s.ndim != 2:
@@ -573,7 +693,13 @@ class PHBase(SPBase):
                 "per-scenario matrices need per-scenario factors and "
                 "gain nothing from chunking)")
         slices = self._chunk_index(chunk)
+        self._drop_if_dirty(key)
+        fresh_states = ("chunks", key) not in self._qp_states
         states = self._ensure_chunk_states(key, factors, data, slices)
+        if fresh_states:
+            # rebuilt chunk states share cold-state buffers — donation
+            # must wait for the first completed pass to privatize them
+            self._chunk_donatable.discard(key)
         polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
         from ..ops.qp_solver import SplitMatrix
         split_mode = isinstance(factors.A_s, SplitMatrix)
@@ -587,14 +713,34 @@ class PHBase(SPBase):
                   polish_chunk=polish_chunk,
                   segment_lo=self.sub_segment_lo,
                   ir_sweeps=self.sub_ir_sweeps)
-        # pass 1 — solves only. (Segmented solves sync on their own
-        # iteration counters internally, so chunks still run in
-        # sequence; the three-pass split buys a SINGLE recovery
-        # decision point over all chunks and keeps objectives computed
-        # strictly on accepted solutions — not cross-chunk overlap.)
-        solved_chunks = []
-        prev_st = None
-        for ci, (idx_c, real) in enumerate(slices):
+        pipeline = bool(int(self.options.get("subproblem_pipeline", 1)))
+        donate = pipeline and key in self._chunk_donatable \
+            and bool(int(self.options.get("subproblem_donate", 1)))
+        if donate:
+            self._chunk_dirty.add(key)   # cleared after pass 3 stores
+        devices = self._spread_devices_for(split_mode) if pipeline else None
+        ent = self._phase_times.setdefault(
+            key, {"acc": {"assemble": 0.0, "solve": 0.0, "gate": 0.0,
+                          "reduce": 0.0},
+                  "calls": 0, "gate_syncs": 0, "devices": 1})
+        acc = ent["acc"]
+        ent["calls"] += 1
+        ent["devices"] = len(devices) if devices else 1
+        gate_syncs = 0
+        t_mark = _time.perf_counter()
+
+        def _lap(phase):
+            nonlocal t_mark
+            now = _time.perf_counter()
+            acc[phase] += now - t_mark
+            t_mark = now
+
+        # record layout (indices 0-3 are the _hospitalize contract):
+        #  [st, x, yA, yB, d_loc, q_loc, dev, fac_loc, d_home, q_home]
+        # *_loc live wherever the solve ran (spread device or home);
+        # *_home are the home-placement twins pass 3 consumes.
+        def _assemble(ci):
+            idx_c, _ = slices[ci]
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
                                 lb=data.lb[idx_c], ub=data.ub[idx_c])
             ws = None if self._w_scale is None else self._w_scale[idx_c]
@@ -603,38 +749,119 @@ class PHBase(SPBase):
                 self.rho[idx_c], self.nonant_idx,
                 self._fixed_mask[idx_c], self._fixed_vals[idx_c], ws,
                 w_on=bool(w_on), prox_on=bool(prox_on))
-            d_c = d_c._replace(lb=bl_c, ub=bu_c)
-            st_in = states[ci]
-            if split_mode and prev_st is not None:
-                # df32: chunks FLOW one (rho_scale, factor) pair through
-                # the sequential loop (the in-jit adaptation keeps its
-                # responsiveness, each chunk inheriting the previous
-                # chunk's adapted stepsize) instead of holding a private
-                # ~0.7 GB factor per chunk — per-chunk copies would
-                # multiply HBM by chunk count x modes at exactly the
-                # scale the split representation exists for. rho is a
-                # stepsize: iterates warm-start across scale changes.
-                st_in = st_in._replace(L=prev_st.L,
-                                       rho_scale=prev_st.rho_scale)
-            st, x, yA, yB = _solver_call(factors, d_c, q_c, st_in, **kw)
-            prev_st = st
-            if split_mode:
-                # record a STRIPPED state: keeping each chunk's L alive
-                # in solved_chunks until pass 3 would pin every
-                # refactorized ~0.7 GB copy simultaneously (the unify
-                # below re-attaches the single flowed factor)
-                st = st._replace(L=jnp.zeros((), jnp.float32))
-            solved_chunks.append([st, x, yA, yB, d_c, q_c])
+            return d_c._replace(lb=bl_c, ub=bu_c), q_c
+
+        # ASSEMBLE — pipelined: enqueue every chunk's assembly now
+        # (async dispatch); the device interleaves this elementwise work
+        # with/ahead of the first solves and the host never again stops
+        # to assemble between chunks
+        inputs = [_assemble(ci) for ci in range(len(slices))] \
+            if pipeline else None
+        _lap("assemble")
+
+        # pass 1 — SOLVE. (Segmented solves sync on their own iteration
+        # counters internally; the three-pass split buys a SINGLE
+        # recovery decision point over all chunks and keeps objectives
+        # computed strictly on accepted solutions.)
+        solved_chunks = [None] * len(slices)
+        prev_st = None
+        if devices and len(slices) > 1:
+            # multi-device chunk spreading: chunk ci runs WHOLE on
+            # devices[ci % n_dev]; each chunk's segmented solve is
+            # driven by its own host thread (the per-segment iteration
+            # readback blocks only that thread), so the sequential
+            # 8-chunk loop becomes ~ceil(8/n_dev) concurrent waves
+            from concurrent.futures import ThreadPoolExecutor
+            from ..parallel.mesh import put_chunk
+            reps = self._spread_replicas(key, factors, data, devices)
+
+            def _run(ci):
+                dev = devices[ci % len(devices)]
+                fac_d, A_d, P_d = reps[dev]
+                d0, q0 = inputs[ci]
+                d_d = QPData(P_d, A_d,
+                             put_chunk(d0.l, dev), put_chunk(d0.u, dev),
+                             put_chunk(d0.lb, dev), put_chunk(d0.ub, dev))
+                q_d = put_chunk(q0, dev)
+                st_in = put_chunk(states[ci], dev)
+                st, x, yA, yB = _solver_call(fac_d, d_d, q_d, st_in,
+                                             donate=donate, **kw)
+                # outputs ship home (async D2D) for the reductions; the
+                # warm-start state stays resident on its device
+                x, yA, yB = self._home_put((x, yA, yB))
+                return [st, x, yA, yB, d_d, q_d, dev, fac_d, d0, q0]
+
+            with ThreadPoolExecutor(
+                    max_workers=min(len(devices), len(slices))) as ex:
+                for ci, rec in enumerate(ex.map(_run,
+                                                range(len(slices)))):
+                    solved_chunks[ci] = rec
+        else:
+            for ci in range(len(slices)):
+                if pipeline:
+                    d_c, q_c = inputs[ci]
+                else:
+                    # sequential opt-out: assembly stays interleaved on
+                    # the critical path, but its wall-clock books under
+                    # "assemble" (advancing t_mark keeps it out of
+                    # "solve") so the seq-vs-pipelined anatomy the
+                    # instrumentation exists for compares honestly
+                    t_a = _time.perf_counter()
+                    d_c, q_c = _assemble(ci)
+                    dt_a = _time.perf_counter() - t_a
+                    acc["assemble"] += dt_a
+                    t_mark += dt_a
+                st_in = states[ci]
+                if split_mode and prev_st is not None:
+                    # df32: chunks FLOW one (rho_scale, factor) pair
+                    # through the sequential loop (the in-jit adaptation
+                    # keeps its responsiveness, each chunk inheriting
+                    # the previous chunk's adapted stepsize) instead of
+                    # holding a private ~0.7 GB factor per chunk —
+                    # per-chunk copies would multiply HBM by chunk
+                    # count x modes at exactly the scale the split
+                    # representation exists for. rho is a stepsize:
+                    # iterates warm-start across scale changes.
+                    st_in = st_in._replace(L=prev_st.L,
+                                           rho_scale=prev_st.rho_scale)
+                st, x, yA, yB = _solver_call(factors, d_c, q_c, st_in,
+                                             donate=donate, **kw)
+                prev_st = st
+                if split_mode:
+                    # record a STRIPPED state: keeping each chunk's L
+                    # alive in solved_chunks until pass 3 would pin
+                    # every refactorized ~0.7 GB copy simultaneously
+                    # (the unify below re-attaches the flowed factor)
+                    st = st._replace(L=jnp.zeros((), jnp.float32))
+                solved_chunks[ci] = [st, x, yA, yB, d_c, q_c, None,
+                                     factors, d_c, q_c]
+        _lap("solve")
         # pass 2 — bounded recovery: a chunk whose warm-started rho
         # trajectory went pathological (per-chunk shared rho adapts on
         # chunk statistics) can exhaust its budget far from
-        # feasibility. ONE sync point reads every chunk's residual;
+        # feasibility. ONE gate point reads every chunk's residual;
         # flagged chunks retry once from a reset rho/factor. The NaN
         # blowup case must flag too, and a chunk whose reset retry
         # didn't help is blacklisted — a genuinely hard chunk must not
         # double every future iteration's cost.
         thr = max(100 * _hot_eps(bool(prox_on), self.sub_eps,
                                  self.sub_eps_hot), 1e-2)
+        # FUSED GATE: all recovery/hospital/standing decisions below
+        # read this host copy of every chunk's pri_rel. Pipelined mode
+        # stacks on device and pays ONE D2H for the whole iteration;
+        # the opt-out keeps the historical one-blocking-sync-per-chunk
+        # reads. Retries update their row from values they already
+        # synced, so the matrix stays current through passes 2/2b.
+        if pipeline:
+            # np.array (not asarray): retry/hospital row writebacks need
+            # a writable host matrix, and jax exports read-only views
+            pri_host = np.array(stacked_residuals(
+                [rec[0] for rec in solved_chunks]))
+            gate_syncs += 1
+        else:
+            pri_host = np.stack([np.asarray(rec[0].pri_rel)
+                                 for rec in solved_chunks])
+            gate_syncs += len(solved_chunks)
         # blacklist RE-ADMISSION (VERDICT r3 #6): PH moves q every
         # iteration, so a row declared incurable under one (W, x̄) may be
         # easy under a later one; permanent blacklists would freeze its
@@ -659,21 +886,22 @@ class PHBase(SPBase):
                            f"(every {readmit} solves)")
         no_retry = self._chunk_no_retry.setdefault(key, set())
         for ci, rec in enumerate(solved_chunks):
-            m = float(jnp.max(rec[0].pri_rel))
+            m = float(pri_host[ci].max())
             is_nan = not np.isfinite(m)
             # the blacklist stops repeated retries of a genuinely hard
             # chunk, but NaN iterates MUST always be replaced — storing
             # them would poison every future warm start
             if (m <= thr) or (ci in no_retry and not is_nan):
                 continue
+            fac_c = rec[7]
             if is_nan:
                 # NaN blowup: the iterates themselves are poison — a
                 # rho reset would re-iterate NaNs; restart cold
-                st_r = qp_cold_state(factors, rec[4])
+                st_r = qp_cold_state(fac_c, rec[4])
             else:
                 # plateaued far out: keep the iterates, reset the
                 # stepsize trajectory
-                st_r = qp_reset_rho(factors, rec[0])
+                st_r = qp_reset_rho(fac_c, rec[0])
             # MIXED configs retry in single-precision-free native mode
             # (engine dtype is f64 there — 'mixed' requires it): the
             # mixed retry's f32 bulk phase re-drives the kept iterates
@@ -686,20 +914,29 @@ class PHBase(SPBase):
             kw_r = dict(kw, precision="native",
                         sub_max_iter=max(kw["sub_max_iter"]
                                          + 4 * kw["tail_iter"], 1500))
-            st2, x2, yA2, yB2 = _solver_call(factors, rec[4], rec[5],
+            st2, x2, yA2, yB2 = _solver_call(fac_c, rec[4], rec[5],
                                              st_r, **kw_r)
-            m2 = float(jnp.max(st2.pri_rel))
+            pri2 = np.asarray(st2.pri_rel)      # exceptional-path sync
+            gate_syncs += 1
+            m2 = float(pri2.max())
             if split_mode:
                 # retry factors are transient too (see the pass-1 strip)
                 st2 = st2._replace(L=jnp.zeros((), jnp.float32))
                 st_r = st_r._replace(L=jnp.zeros((), jnp.float32))
             if np.isfinite(m2) and (is_nan or m2 < m):
+                if rec[6] is not None:
+                    x2, yA2, yB2 = self._home_put((x2, yA2, yB2))
                 rec[:4] = [st2, x2, yA2, yB2]
+                pri_host[ci] = pri2
             elif is_nan:
                 # both attempts NaN: keep the CLEAN cold state so the
                 # next iteration starts from finite values (zero duals
                 # still certify a valid, if loose, bound)
-                rec[:4] = [st_r, st_r.x, st_r.yA, st_r.yB]
+                xr, yAr, yBr = st_r.x, st_r.yA, st_r.yB
+                if rec[6] is not None:
+                    xr, yAr, yBr = self._home_put((xr, yAr, yBr))
+                rec[:4] = [st_r, xr, yAr, yBr]
+                pri_host[ci] = np.inf   # cold-state residuals
             if not (m2 <= thr):
                 no_retry.add(ci)
         # pass 2b — scenario HOSPITAL: scenarios still far out after the
@@ -721,18 +958,19 @@ class PHBase(SPBase):
             # exists for (one (n, n) f64 host inversion there costs
             # minutes); stragglers rely on chunk retries + blacklist
             # re-admission instead
-            self._hospitalize(key, slices, solved_chunks, data, thr,
-                              bool(w_on), bool(prox_on), kw)
+            treated = self._hospitalize(key, slices, solved_chunks, data,
+                                        thr, bool(w_on), bool(prox_on),
+                                        kw, pri_host=pri_host)
+            gate_syncs += treated
         # standing-casualty observability (VERDICT r3 #6): rows STILL
         # above the gate after recovery + hospital enter x̄/W with their
         # loose solutions this iteration — that must be visible in the
-        # trace, not only the hospital's treatment log. The residual
-        # arrays were already pulled to host by passes 2/2b, so this
-        # costs no extra device sync.
+        # trace, not only the hospital's treatment log. pri_host was
+        # kept current through passes 2/2b, so this is free host math.
         if self.verbose or self.options.get("hospital_trace", True):
             standing = []
             for ci, (idx_c, real) in enumerate(slices):
-                pr = np.asarray(solved_chunks[ci][0].pri_rel)[:real]
+                pr = pri_host[ci][:real]
                 for r in np.flatnonzero(~(pr <= thr)):
                     standing.append((int(np.asarray(idx_c)[r]),
                                      float(pr[r])))
@@ -744,14 +982,17 @@ class PHBase(SPBase):
                     f"standing: {len(standing)} scenario row(s) above "
                     f"pri_rel gate {thr:.0e} enter xbar/W loose "
                     f"(worst s{g_w}:{pr_w:.0e}; {when})")
+        ent["gate_syncs"] += gate_syncs
+        _lap("gate")
         # pass 3 — per-chunk objectives on the accepted solutions
         parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
                                  "dual")}
         for ci, (idx_c, real) in enumerate(slices):
-            st, x, yA, yB, d_c, q_c = solved_chunks[ci]
+            st, x, yA, yB = solved_chunks[ci][:4]
+            d_h, q_h = solved_chunks[ci][8], solved_chunks[ci][9]
             states[ci] = st
             xn, base, solved, dual = _ph_chunk_objs(
-                x, yA, yB, d_c, q_c, self.c[idx_c], self.c0[idx_c],
+                x, yA, yB, d_h, q_h, self.c[idx_c], self.c0[idx_c],
                 self.P_diag[idx_c], self.nonant_idx, self.W[idx_c],
                 w_on=bool(w_on))
             for k, v in (("x", x[:real]), ("yA", yA[:real]),
@@ -768,6 +1009,11 @@ class PHBase(SPBase):
             for ci in range(len(states)):
                 states[ci] = states[ci]._replace(
                     L=prev_st.L, rho_scale=prev_st.rho_scale)
+        # from here the chunk states are solve outputs with privately
+        # owned buffers — the NEXT pass of this mode may donate them,
+        # and this pass's donation window is closed
+        self._chunk_dirty.discard(key)
+        self._chunk_donatable.add(key)
         cat = {k: jnp.concatenate(v) for k, v in parts.items()}
         # lazily concatenated read-only view for the state consumers
         # (assert_feasible_iter0, incumbent feasibility, bench prints);
@@ -789,11 +1035,40 @@ class PHBase(SPBase):
         self._last_base_obj = cat["base"]
         self._last_solved_obj = cat["solved"]
         self._last_dual_obj = cat["dual"]
+        _lap("reduce")
         self._ext("post_solve")
         return cat["solved"]
 
+    def reset_phase_timing(self):
+        """Zero the per-phase accumulators (bench timing windows)."""
+        self._phase_times.clear()
+
+    def phase_timing(self, key=True):
+        """Per-phase wall-clock anatomy of the chunked hot loop for one
+        mode key: mean seconds per solve_loop call in each pipeline
+        phase (assemble / solve / gate / reduce), the device-busy
+        occupancy estimate solve/(total) — the solve phase is the only
+        one that blocks on device compute, so everything else is host
+        orchestration the pipeline exists to shrink — and the gate's
+        D2H sync count per call (the O(chunks) -> O(1) acceptance
+        evidence). Returns None when the key never ran chunked."""
+        ent = self._phase_times.get(key)
+        if not ent or not ent["calls"]:
+            return None
+        n = ent["calls"]
+        per_call = {p: ent["acc"][p] / n for p in
+                    ("assemble", "solve", "gate", "reduce")}
+        total = sum(per_call.values())
+        return {
+            "calls": n,
+            "seconds_per_call": per_call,
+            "occupancy": (per_call["solve"] / total) if total > 0 else 0.0,
+            "gate_d2h_syncs_per_call": ent["gate_syncs"] / n,
+            "devices": ent["devices"],
+        }
+
     def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,
-                     prox_on, kw):
+                     prox_on, kw, pri_host=None):
         """Per-scenario rescue solves for chunked-mode stragglers (see
         the pass-2b comment in _solve_loop_chunked). Selected scenarios
         are re-assembled and solved NON-shared (own Ruiz/cost scaling
@@ -805,7 +1080,13 @@ class PHBase(SPBase):
         batched (cap, n, n) f64 factorization is a single long device
         execution, and a cap of 16 tripped the TPU watchdog on the
         1024-scenario UC run; scenarios beyond the cap stay flagged and
-        are picked up (worst-first) on subsequent iterations."""
+        are picked up (worst-first) on subsequent iterations.
+
+        ``pri_host`` ((n_chunks, chunk) host residual matrix from the
+        fused gate): selection reads it instead of one D2H per chunk,
+        and cured rows are written back so the standing-casualty trace
+        stays current. Returns the number of host transfers performed
+        (0 or 1) for the caller's sync accounting."""
         cap = int(self.options.get("subproblem_hospital_max", 4))
         # scenarios the hospital already failed to improve: skip them
         # forever (same recurring-cost bound as pass 2's no_retry — a
@@ -814,7 +1095,8 @@ class PHBase(SPBase):
         failed = self._hospital_no_retry.setdefault(key, set())
         picks = []                      # (chunk, row, global scenario)
         for ci, (idx_c, real) in enumerate(slices):
-            pr = np.asarray(solved_chunks[ci][0].pri_rel)[:real]
+            pr = (np.asarray(solved_chunks[ci][0].pri_rel)
+                  if pri_host is None else pri_host[ci])[:real]
             for r in np.flatnonzero(~(pr <= thr)):
                 g = int(np.asarray(idx_c)[r])
                 # keyed by GLOBAL scenario id: chunk-local coordinates
@@ -823,7 +1105,7 @@ class PHBase(SPBase):
                 if g not in failed:
                     picks.append((ci, int(r), g, float(pr[r])))
         if not picks:
-            return
+            return 0
         picks.sort(key=lambda t: -t[3])     # worst first under the cap
         picks = picks[:cap]
         sel = np.array([g for _, _, g, _ in picks])
@@ -883,14 +1165,25 @@ class PHBase(SPBase):
             # stalls again next iteration the hospital re-fires
             # (bounded: once per iteration, capped batch, failed rows
             # never re-admitted).
+            res_rows = (st_h.pri_res[j], st_h.dua_res[j],
+                        st_h.pri_rel[j], st_h.dua_rel[j])
+            dev = rec[6] if len(rec) > 6 else None
+            if dev is not None:
+                # spread mode keeps the warm-start state resident on
+                # its round-robin device; the hospital solved at home
+                # placement, so its rows ship over before the scatter
+                res_rows = jax.device_put(res_rows, dev)
             rec[0] = st._replace(
-                pri_res=st.pri_res.at[r].set(st_h.pri_res[j]),
-                dua_res=st.dua_res.at[r].set(st_h.dua_res[j]),
-                pri_rel=st.pri_rel.at[r].set(st_h.pri_rel[j]),
-                dua_rel=st.dua_rel.at[r].set(st_h.dua_rel[j]))
+                pri_res=st.pri_res.at[r].set(res_rows[0]),
+                dua_res=st.dua_res.at[r].set(res_rows[1]),
+                pri_rel=st.pri_rel.at[r].set(res_rows[2]),
+                dua_rel=st.dua_rel.at[r].set(res_rows[3]))
             rec[1] = rec[1].at[r].set(x_h[j])
             rec[2] = rec[2].at[r].set(yA_h[j])
             rec[3] = rec[3].at[r].set(yB_h[j])
+            if pri_host is not None:
+                pri_host[ci][r] = pr_h[j]
+        return 1
 
     def _dive_in_chunks(self, factors, d, q, c0, st, imask, **kw):
         """core.mip.dive_integers with scenario microbatching. Dives

@@ -160,6 +160,18 @@ def analyze_structure(rows, cols, m, n, nnz_thresholds=None,
     return None
 
 
+def pk_nbytes(pk: Packed) -> int:
+    """Bytes of matrix operands one packed A-pass streams from HBM (the
+    value arrays; index vectors are noise). The observability companion
+    to the per-phase pipeline timing: bench.py records the hi+lo packed
+    operand footprint in its uc1024 JSON row next to MFU, making the
+    bandwidth-bound cost basis of the hot loop auditable (see
+    doc/roofline.md — dense-equivalent MFU understates a packed kernel
+    by the sparsity factor)."""
+    return int(pk.g_vals.size * pk.g_vals.dtype.itemsize
+               + pk.l_vals.size * pk.l_vals.dtype.itemsize)
+
+
 @jax.jit
 def pack(structure: PackStructure, dense) -> Packed:
     """Gather one dense (m, n) device matrix into packed form. Padded

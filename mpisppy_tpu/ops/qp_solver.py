@@ -1016,9 +1016,11 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
     return new_state, x_un, yA_un, yB_un
 
 
-@partial(jax.jit, static_argnames=("max_iter", "check_every", "adaptive_rho",
-                                   "polish", "polish_iters", "polish_chunk",
-                                   "stall_rel", "ir_sweeps"))
+_SOLVE_STATICS = ("max_iter", "check_every", "adaptive_rho", "polish",
+                  "polish_iters", "polish_chunk", "stall_rel", "ir_sweeps")
+
+
+@partial(jax.jit, static_argnames=_SOLVE_STATICS)
 def _qp_solve_jit(factors: QPFactors, data: QPData, q, state: QPState,
                   max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
                   alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
@@ -1031,17 +1033,37 @@ def _qp_solve_jit(factors: QPFactors, data: QPData, q, state: QPState,
                        stall_rel, ir_sweeps)
 
 
+# DONATED twin of _qp_solve_jit: the incoming QPState's buffers are handed
+# to XLA for reuse (``jax.jit(donate_argnames=("state",))``), so a solve
+# that carries L through unchanged ALIASES it into the output instead of
+# materializing a fresh (n, n) copy per call — at reference-UC scale each
+# warm-started segment call otherwise produces a new ~0.7 GB factor buffer
+# (4 segments/solve ≈ the +2.7 GB-per-chunk churn noted at core/ph.py's
+# assemble boundary). CALLER CONTRACT: every leaf of ``state`` must be
+# uniquely owned — after the call the input state's arrays are DELETED
+# (reads raise), including leaves the program only passed through. The
+# chunked PH driver tracks ownership (first pass after a (re)build shares
+# cold-state buffers across chunks and must not donate); everyone else
+# defaults to the copying twin.
+_qp_solve_jit_donated = jax.jit(
+    _solve_impl, static_argnames=_SOLVE_STATICS, donate_argnames=("state",))
+
+
 _WARNED_FROZEN_RHO = False
 
 
 def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
-             **kw):
+             donate=False, **kw):
     """Single-precision solve (see _solve_impl). On backends whose f64
     device linalg is untrusted (see _device_f64_linalg_trusted),
     non-shared f64 solves run with IN-JIT rho refactorization disabled —
     the warm state's host-exact inverse (qp_cold_state / qp_reset_rho /
     the mixed handoff) stays valid for the whole call, and the axon
-    runtime offers no host callback to refactorize mid-loop."""
+    runtime offers no host callback to refactorize mid-loop.
+
+    ``donate=True`` routes through the donated jit (see
+    _qp_solve_jit_donated): ``state``'s buffers are consumed — only pass
+    a state no other live object references."""
     if kw.get("adaptive_rho", True) and _needs_host_factor(factors):
         kw["adaptive_rho"] = False
         # direct callers (not qp_solve_segmented, which substitutes
@@ -1065,11 +1087,12 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
                     "segment boundaries.", RuntimeWarning, stacklevel=2)
     else:
         kw.pop("_segmented_caller", None)
-    return _qp_solve_jit(factors, data, q, state, **kw)
+    fn = _qp_solve_jit_donated if donate else _qp_solve_jit
+    return fn(factors, data, q, state, **kw)
 
 
 def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
-                       max_iter=4000, segment=500, **kw):
+                       max_iter=4000, segment=500, donate=False, **kw):
     """Host-driven segmented solve: run the jitted loop in warm-started
     SEGMENTS of at most ``segment`` iterations (polish deferred to one
     final call), accumulating until convergence/stall or ``max_iter``.
@@ -1086,10 +1109,16 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
     NOTE: segments always run FULL (``segment`` is a static jit arg),
     so the total can overshoot ``max_iter`` by up to one segment —
     ``max_iter=100, segment=500`` runs up to 500 iterations. Callers
-    that need a hard ceiling pass ``segment <= max_iter``."""
+    that need a hard ceiling pass ``segment <= max_iter``.
+
+    ``donate`` applies to the CALLER's ``state`` only; once the first
+    segment has produced a chain-owned successor, every later segment
+    donates it regardless (the chain is this function's private state,
+    so per-segment factor copies die even for non-donating callers)."""
     final_polish = kw.pop("polish", True)
     host_adapt = kw.get("adaptive_rho", True) and _needs_host_factor(factors)
     total = 0
+    owned = donate
     while total < max_iter:
         # always run FULL segments: max_iter is a static jit arg, so a
         # data-dependent remainder would compile a whole extra UC-sized
@@ -1099,7 +1128,9 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
         t_seg = time.perf_counter()
         state, _, _, _ = qp_solve(factors, data, q, state,
                                   max_iter=segment, polish=False,
-                                  _segmented_caller=True, **kw)
+                                  donate=owned, _segmented_caller=True,
+                                  **kw)
+        owned = True
         _trace_seg("hi-seg", t_seg, state)
         ran = int(state.iters)
         total += ran
@@ -1116,7 +1147,7 @@ def qp_solve_segmented(factors: QPFactors, data: QPData, q, state: QPState,
             state = _host_adapt_rho(factors, state)
     # final call: loop skipped (max_iter=0), polish runs
     state, x, yA, yB = qp_solve(factors, data, q, state, max_iter=0,
-                                polish=final_polish,
+                                polish=final_polish, donate=owned,
                                 _segmented_caller=True, **kw)
     state = state._replace(iters=jnp.asarray(total, jnp.int32))
     return state, x, yA, yB
@@ -1158,7 +1189,7 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
                    eps_abs=1e-6, eps_rel=1e-6, alpha=1.6, adaptive_rho=True,
                    polish=True, polish_iters=12, polish_chunk=0,
                    eps_abs_dua=None, eps_rel_dua=None, stall_rel=0.0,
-                   segment=500, segment_lo=None, ir_sweeps=1):
+                   segment=500, segment_lo=None, ir_sweeps=1, donate=False):
     """Precision-escalated solve: an f32 bulk phase (MXU-friendly — the
     thousands of ADMM matmuls run at accelerator speed) followed by an f64
     tail (one refactorization + a few hundred iterations + the polish).
@@ -1229,16 +1260,29 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
         raise ValueError("segment_lo must be positive (None = use "
                          "`segment` for both phases)")
     seg_lo = segment if segment_lo is None else int(segment_lo)
+    # donation ownership through the f32 chain: the initial st_lo is
+    # fresh casts of the caller's f64 state EXCEPT two leaves that alias
+    # it outright — iters (int, never cast) and, in df32 mode, the f32
+    # factor L (same-dtype astype is a no-op). So the FIRST lo segment
+    # may donate only when the caller donated AND the factor is not the
+    # aliased df32 one; every later segment owns its input outright.
+    split = isinstance(factors.A_s, SplitMatrix)
+    owned_lo = donate and not split
+    lo_ran = False
+    q_lo = q.astype(lo)
     lo_total = 0
     while lo_total < max_iter:
         # constant segment size — see qp_solve_segmented on why the
         # remainder must not become a fresh static max_iter
         t_seg = time.perf_counter()
-        st_lo, _, _, _ = _solve_lo_jit(f_lo, d_lo, q.astype(lo), st_lo,
-                                       seg_lo, check_every, eps_lo,
-                                       eps_rel_lo, alpha, adaptive_rho,
-                                       polish_iters, eps_rel_lo_dua,
-                                       stall_rel)
+        fn_lo = _solve_lo_jit_donated if owned_lo else _solve_lo_jit
+        st_lo, _, _, _ = fn_lo(f_lo, d_lo, q_lo, st_lo,
+                               seg_lo, check_every, eps_lo,
+                               eps_rel_lo, alpha, adaptive_rho,
+                               polish_iters, eps_rel_lo_dua,
+                               stall_rel)
+        owned_lo = True
+        lo_ran = True
         _trace_seg("lo-seg", t_seg, st_lo)
         ran = int(st_lo.iters)
         lo_total += ran
@@ -1262,32 +1306,59 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
     st_hi = st_hi._replace(L=L_hi, rho_scale=rho_hi)
     # the f64 tail is the real solver: full termination test, rho
     # adaptation on (it refactorizes in f64 when needed), early exit when
-    # the warm start was already good (prox-regularized solves)
+    # the warm start was already good (prox-regularized solves).
+    # Ownership of st_hi: its float leaves are fresh f32->f64 casts and
+    # L_hi is either the lo chain's output (df32, lo_ran) or a fresh
+    # factorization — but iters passes through uncast, so when the lo
+    # loop never ran it still aliases the CALLER's state (and in df32
+    # L_hi aliases the caller's factor too); donate only when the chain
+    # ran or the caller consented on a non-split state.
     st_hi, x, yA, yB = qp_solve_segmented(
         factors, data, q, st_hi, max_iter=tail_iter, segment=segment,
         check_every=check_every, eps_abs=eps_abs, eps_rel=eps_rel,
         alpha=alpha, adaptive_rho=adaptive_rho, polish=polish,
         polish_iters=polish_iters, polish_chunk=polish_chunk,
         eps_abs_dua=eps_abs_dua, eps_rel_dua=eps_rel_dua,
-        stall_rel=stall_rel, ir_sweeps=ir_sweeps)
+        stall_rel=stall_rel, ir_sweeps=ir_sweeps,
+        donate=lo_ran or (donate and not split))
     # total iteration count across both phases
     st_hi = st_hi._replace(iters=jnp.asarray(lo_total, jnp.int32)
                            + st_hi.iters)
     return st_hi, x, yA, yB
 
 
-@partial(jax.jit, static_argnames=("max_iter", "check_every",
-                                   "adaptive_rho", "polish_iters",
-                                   "stall_rel"))
-def _solve_lo_jit(f_lo, d_lo, q_lo, st_lo, max_iter, check_every, eps_abs,
-                  eps_rel, alpha, adaptive_rho, polish_iters, eps_rel_dua,
-                  stall_rel):
+def _solve_lo_impl(f_lo, d_lo, q_lo, st_lo, max_iter, check_every, eps_abs,
+                   eps_rel, alpha, adaptive_rho, polish_iters, eps_rel_dua,
+                   stall_rel):
     """One polish-free f32 segment of qp_solve_mixed."""
     st_lo, _, _, _ = _solve_impl(f_lo, d_lo, q_lo, st_lo, max_iter,
                                  check_every, eps_abs, eps_rel, alpha,
                                  adaptive_rho, False, polish_iters, 0,
                                  eps_abs, eps_rel_dua, stall_rel)
     return st_lo, None, None, None
+
+
+_LO_STATICS = ("max_iter", "check_every", "adaptive_rho", "polish_iters",
+               "stall_rel")
+_solve_lo_jit = jax.jit(_solve_lo_impl, static_argnames=_LO_STATICS)
+# donated twin — same ownership contract as _qp_solve_jit_donated; the
+# f32 chain is qp_solve_mixed's private state after the first segment
+_solve_lo_jit_donated = jax.jit(_solve_lo_impl, static_argnames=_LO_STATICS,
+                                donate_argnames=("st_lo",))
+
+
+def stacked_residuals(states, field="pri_rel"):
+    """One device-side stack of per-chunk residual vectors ->
+    (n_chunks, chunk). The chunked PH quality gates read EVERY chunk's
+    residuals each iteration; transferring them one chunk at a time
+    costs ceil(S/chunk) blocking D2H syncs — stacking on device first
+    means the caller pays exactly ONE host transfer
+    (``np.asarray(stacked_residuals(...))``) per PH iteration. Chunks
+    solved on different devices (multi-device spreading) are colocated
+    onto the first chunk's device before the stack; those copies ride
+    the device interconnect asynchronously."""
+    from ..parallel.mesh import colocate
+    return jnp.stack(colocate([getattr(s, field) for s in states]))
 
 
 def _unscaled_residuals(A_s, P_s, g, D, E, Eb, csx, q_s, x, yA, yB, zA, zB):

@@ -53,6 +53,47 @@ def shard_arrays(mesh: Mesh, arrays: dict[str, jax.Array]) -> dict[str, jax.Arra
     return out
 
 
+def replicated_sharding(mesh: Mesh, rank: int) -> NamedSharding:
+    """Fully-replicated placement on the mesh — the 'home' placement the
+    pipelined chunk driver returns spread-solve outputs to, so they can
+    mix with the engine's GSPMD-sharded reduction inputs (a single-device
+    commitment would refuse to colocate with mesh-committed arrays)."""
+    return NamedSharding(mesh, P(*([None] * rank)))
+
+
+def spread_devices(mesh=None):
+    """Device list for round-robin CHUNK spreading (core/ph pipelined
+    dispatch), or None when there is nothing to spread over. Unlike the
+    GSPMD scenario sharding above — which partitions ONE batched solve
+    across the mesh — chunk spreading places whole microbatch solves on
+    single devices with explicit device_put, turning the host-looped
+    sequential chunk chain into ~ceil(n_chunks/n_dev) concurrent waves.
+    The two compose: the mesh keeps the reductions collective while the
+    chunk solves ride per-device execution streams."""
+    if mesh is None:
+        return None
+    devs = list(np.asarray(mesh.devices).flat)
+    return devs if len(devs) > 1 else None
+
+
+def put_chunk(tree, device):
+    """device_put a pytree (QPData/QPFactors/QPState/arrays) onto one
+    device. Arrays already committed there pass through without a copy,
+    so per-iteration re-pinning of resident chunk states is free."""
+    return jax.device_put(tree, device)
+
+
+def colocate(parts):
+    """Normalize a list of arrays onto one placement (the first part's
+    device) when chunk spreading left them committed to different
+    devices — the shared precondition of jnp.stack/concatenate over
+    per-chunk results. Single-placement inputs pass through untouched."""
+    if len({tuple(sorted(map(str, p.devices()))) for p in parts}) <= 1:
+        return parts
+    dev = next(iter(parts[0].devices()))
+    return [jax.device_put(p, dev) for p in parts]
+
+
 def pad_batch_for_mesh(batch, n_shards: int):
     """Pad a ScenarioBatch to a multiple of n_shards scenarios with
     zero-probability copies of the last scenario. Returns (batch, S_orig)."""

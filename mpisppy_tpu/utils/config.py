@@ -40,6 +40,10 @@ class AlgoConfig:
     subproblem_max_iter: int = 5000
     subproblem_eps: float = 1e-8
     subproblem_polish_chunk: int = 0
+    # pipelined chunk dispatch (doc/pipelining.md): pre-assembled
+    # chunks + fused quality-gate sync + donated warm starts; 0 opts
+    # back into the strictly sequential debug loop
+    subproblem_pipeline: int = 1
     linearize_proximal_terms: bool = False   # accepted + ignored (see ph.py)
     verbose: bool = False
 
@@ -51,6 +55,7 @@ class AlgoConfig:
             "subproblem_max_iter": self.subproblem_max_iter,
             "subproblem_eps": self.subproblem_eps,
             "subproblem_polish_chunk": self.subproblem_polish_chunk,
+            "subproblem_pipeline": self.subproblem_pipeline,
             "verbose": self.verbose,
         }
 

@@ -2,7 +2,14 @@
 where does a 15.8 s chunk solve actually spend its wall-clock?
 Run with MPISPPY_TPU_SOLVE_TRACE=1 to get per-segment stamps.
 Not part of the bench — a measurement tool for the r5 MFU work.
+
+PROFILE_CHUNK=<n> (env) additionally drives the CHUNKED pipelined path
+(subproblem_chunk=n) and prints the per-phase pipeline anatomy
+(assemble / solve / gate / reduce seconds, device-busy occupancy, gate
+D2H syncs per iteration) that the r6 pipelined-dispatch work optimizes
+— the same numbers bench.py records into its uc1024 JSON row.
 """
+import os
 import sys
 import time
 
@@ -28,12 +35,17 @@ def main():
     from mpisppy_tpu.models import uc
 
     S = 128
+    chunk = int(os.environ.get("PROFILE_CHUNK", "0"))
+    opts = dict(DF32)
+    if chunk:
+        opts["subproblem_chunk"] = chunk
     stamp(f"building S={S} batch")
     batch = build_batch(uc.scenario_creator, uc.make_tree(S),
                         creator_kwargs=INSTANCE,
                         vector_patch=uc.scenario_vector_patch)
-    stamp("batch built; engine setup")
-    ph = PHBase(batch, dict(DF32), dtype=jax.numpy.float64)
+    stamp("batch built; engine setup"
+          + (f" (chunked, chunk={chunk})" if chunk else " (fused)"))
+    ph = PHBase(batch, opts, dtype=jax.numpy.float64)
     stamp("warmup iter0 (compiles)")
     ph.solve_loop(w_on=False, prox_on=False)
     ph.W = ph.W_new
@@ -45,6 +57,7 @@ def main():
     ph.solve_loop(w_on=True, prox_on=True)
     ph.W = ph.W_new
     jax.block_until_ready(ph.x)
+    ph.reset_phase_timing()
     for k in range(2):
         stamp(f"TIMED hot solve {k + 1}/2")
         t0 = time.perf_counter()
@@ -53,6 +66,15 @@ def main():
         jax.block_until_ready(ph.x)
         stamp(f"TIMED hot solve {k + 1}/2 done: "
               f"{time.perf_counter() - t0:.2f}s")
+    pt = ph.phase_timing(True)
+    if pt is not None:
+        per = pt["seconds_per_call"]
+        stamp("pipeline anatomy per PH iteration: "
+              + " ".join(f"{p}={per[p]:.3f}s"
+                         for p in ("assemble", "solve", "gate", "reduce"))
+              + f" | occupancy={pt['occupancy']:.3f}"
+              + f" gate_d2h_syncs={pt['gate_d2h_syncs_per_call']:.1f}"
+              + f" devices={pt['devices']}")
     pri = float(np.asarray(ph._qp_states[True].pri_rel).max())
     stamp(f"final max pri_rel {pri:.2e}")
 
