@@ -65,6 +65,8 @@ import time
 import jax
 import numpy as np
 
+from mpisppy_tpu import obs
+
 _T0 = time.perf_counter()
 BUDGET = float(os.environ.get("BENCH_BUDGET", "1800"))
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -86,8 +88,11 @@ def _progress(msg):
 
 def emit(obj):
     """Print a metric line AND persist it to BENCH_partial.json
-    atomically — a timeout kill must never erase landed evidence."""
+    atomically — a timeout kill must never erase landed evidence. The
+    row also lands in the unified telemetry event stream (bench.metric)
+    so BENCH evidence merges with the run's counters/spans."""
     print(json.dumps(obj), flush=True)
+    obs.event("bench.metric", obj)
     _EMITTED.append(obj)
     tmp = _PARTIAL_PATH + ".tmp"
     with open(tmp, "w") as f:
@@ -270,6 +275,7 @@ def bench_1024():
     _progress("uc1024: timing 2 iterations")
     ph.reset_phase_timing()   # warmup iterations must not dilute the
     total_iters = 0           # per-phase anatomy of the timed window
+    c_before = obs.counters_snapshot()   # counters survive the reset
     t0 = time.perf_counter()
     for _ in range(2):
         ph.solve_loop(w_on=True, prox_on=True)
@@ -291,6 +297,13 @@ def bench_1024():
     # syncs are O(1) per iteration, not O(chunks)
     pt = ph.phase_timing(True) or {}
     per_call = pt.get("seconds_per_call", {})
+    # timed-window telemetry counter deltas (obs): the SAME counters
+    # the tier-1 invariant tests assert on (ph.gate_syncs O(1)/iter,
+    # qp.donated_passes), so a BENCH row and a test read one source
+    c_after = obs.counters_snapshot()
+    ctr_window = {k: c_after[k] - c_before.get(k, 0) for k in c_after
+                  if k.split(".")[0] in ("ph", "qp")} \
+        if obs.enabled() else None
     # packed operand footprint: bytes one split A-pass (hi+lo pair)
     # streams — the hot loop's bandwidth-bound cost basis (see
     # ops/packed.pk_nbytes / doc/roofline.md)
@@ -321,6 +334,7 @@ def bench_1024():
         "gate_d2h_syncs_per_iter": pt.get("gate_d2h_syncs_per_call"),
         "spread_devices": pt.get("devices", 1),
         "packed_matvec_mbytes_per_pass": pk_mb,
+        "telemetry_counters_timed_window": ctr_window,
     })
     _progress(f"uc1024: pipeline occupancy "
               f"{pt.get('occupancy', 0.0):.3f} (device-busy fraction), "
@@ -378,6 +392,13 @@ def _flush_active_wheel(signum=None, frame=None):
             os.replace(_KILLED_PATH + ".tmp", _KILLED_PATH)
         except Exception:
             pass   # dying anyway; partials on disk stay uncorrupted
+    try:
+        # nonblocking: the interrupted main-thread frame may hold a
+        # telemetry sink lock — a blocking flush here would deadlock
+        # the kill path the handler exists to protect
+        obs.flush(nonblocking=True)
+    except Exception:
+        pass
     if signum is not None:
         sys.exit(124)
 
@@ -670,6 +691,18 @@ def main():
                                      "/tmp/mpisppy_tpu_jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     enable_honest_f32()
+    # unified telemetry: on by default into ./BENCH_telemetry (one
+    # artifact set per bench run: events.jsonl + trace.json +
+    # metrics.json); BENCH_TELEMETRY=0 disables, and
+    # MPISPPY_TPU_TELEMETRY_DIR redirects the output directory
+    if os.environ.get("BENCH_TELEMETRY", "1") not in ("0", "false"):
+        tdir = os.environ.get(
+            "MPISPPY_TPU_TELEMETRY_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_telemetry"))
+        obs.configure(out_dir=tdir,
+                      config={"bench": True, "budget_s": BUDGET,
+                              "instance": INSTANCE_STR, "df32": DF32})
     signal.signal(signal.SIGTERM, _flush_active_wheel)
     # clear a previous run's partials AND killed-rows file BEFORE any
     # phase: a run that dies pre-first-emit must leave empty artifacts,
@@ -706,6 +739,7 @@ def main():
             _progress(f"PHASE FAILED {name}: {e!r}")
             traceback.print_exc(file=sys.stderr)
     _release_device(1024)
+    obs.shutdown()   # flush trace.json/metrics.json with the run alive
 
 
 if __name__ == "__main__":

@@ -55,6 +55,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--EF", action="store_true", dest="solve_ef")
     p.add_argument("--EF-integer", action="store_true", dest="ef_integer")
     p.add_argument("--trace-prefix", type=str, default=None)
+    p.add_argument("--telemetry-dir", type=str, default=None,
+                   help="enable unified telemetry (mpisppy_tpu.obs): "
+                        "write events.jsonl, trace.json (Chrome "
+                        "trace-event; load in Perfetto) and "
+                        "metrics.json under this directory — see "
+                        "doc/observability.md")
     p.add_argument("--f32", action="store_true",
                    help="run in float32 (faster on TPU; bounds and "
                         "objectives carry ~1e-3 relative noise). Default "
@@ -81,31 +87,49 @@ def config_from_args(args) -> RunConfig:
         num_bundles=args.num_bundles, hub=args.hub, algo=algo,
         spokes=spokes, rel_gap=args.rel_gap, abs_gap=args.abs_gap,
         solve_ef=args.solve_ef, ef_integer=args.ef_integer,
-        trace_prefix=args.trace_prefix,
+        trace_prefix=args.trace_prefix, telemetry_dir=args.telemetry_dir,
     ).validate()
 
 
 def run(cfg: RunConfig):
-    from . import global_toc
+    from . import global_toc, obs
 
-    if cfg.solve_ef:
-        from .core.ef import ExtensiveForm
-        from .utils.vanilla import build_batch_for
+    # telemetry session: --telemetry-dir wins; otherwise the
+    # MPISPPY_TPU_TELEMETRY_DIR env var can enable it without flags
+    if cfg.telemetry_dir:
+        obs.configure(out_dir=cfg.telemetry_dir, config=cfg.to_dict())
+    else:
+        obs.maybe_configure_from_env()
+    try:
+        if cfg.solve_ef:
+            from .core.ef import ExtensiveForm
+            from .utils.vanilla import build_batch_for
 
-        ef = ExtensiveForm(build_batch_for(cfg))
-        obj, _ = ef.solve_extensive_form(integer=cfg.ef_integer)
-        global_toc(f"EF objective: {obj:.4f}")
-        return {"ef_objective": obj}
+            ef = ExtensiveForm(build_batch_for(cfg))
+            obj, _ = ef.solve_extensive_form(integer=cfg.ef_integer)
+            global_toc(f"EF objective: {obj:.4f}")
+            result = {"ef_objective": obj}
+        else:
+            from .utils.vanilla import wheel_dicts
+            from .utils.sputils import spin_the_wheel
 
-    from .utils.vanilla import wheel_dicts
-    from .utils.sputils import spin_the_wheel
-
-    hub_d, spoke_ds = wheel_dicts(cfg)
-    wheel = spin_the_wheel(hub_d, spoke_ds)
-    # never-established bounds report as null, not JSON-invalid Infinity
-    fin = lambda v: v if v is not None and math.isfinite(v) else None
-    return {"outer_bound": fin(wheel.hub.BestOuterBound),
-            "inner_bound": fin(wheel.best_inner_bound)}
+            hub_d, spoke_ds = wheel_dicts(cfg)
+            wheel = spin_the_wheel(hub_d, spoke_ds)
+            # never-established bounds report as null, not
+            # JSON-invalid Infinity
+            fin = lambda v: v if v is not None and math.isfinite(v) \
+                else None  # noqa: E731
+            result = {"outer_bound": fin(wheel.hub.BestOuterBound),
+                      "inner_bound": fin(wheel.best_inner_bound)}
+        obs.event("run.result", result)
+        return result
+    finally:
+        if cfg.telemetry_dir:
+            # flush + close so the artifacts are complete the moment
+            # run() returns (tests and scripts read them right after)
+            obs.shutdown()
+        else:
+            obs.flush()
 
 
 def main(argv=None):

@@ -218,6 +218,17 @@ class LShapedMethod(PHBase):
         # the ub, and the cut rebuild, so the duals, the incumbent value and
         # the cut all describe the same (integer-feasible) first stage
         xf = self.round_nonants(xf)
+        # the pinned solve must NOT warm-start from the previous master
+        # point's iterates: a fully-pinned LP is dual-degenerate along
+        # the pinned columns (the bound duals have free rays), and
+        # warm-started duals drift unboundedly across successive
+        # points while residuals stay tiny (measured on farmer: yA
+        # max 3e3 -> 2e10 over four cut rounds, cut constants reaching
+        # -inf and the master LB frozen at the wait-and-see bound).
+        # Dropping the cached state rebuilds it cold with a CLEAN
+        # transplant from the prox-off mode (_ensure_state).
+        self._qp_states.pop(("fixed", False), None)
+        self._qp_states.pop(("chunks", ("fixed", False)), None)
         self.fix_nonants(xf)
         try:
             self.solve_loop(w_on=False, prox_on=False, update=False,

@@ -27,19 +27,38 @@ TPU redesign — one jitted step per PH iteration over the whole batch:
 
 from __future__ import annotations
 
+import logging
+import time as _time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import global_toc
+from .. import global_toc, log as _log_setup, obs  # noqa: F401  (log import
+#   installs the quiet "mpisppy_tpu" root handler the child logger
+#   propagates to)
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import (QPData, QPState, qp_setup, qp_solve,
                              qp_solve_mixed, qp_solve_segmented,
                              qp_cold_state, qp_dual_objective,
                              qp_reset_rho, stacked_residuals)
 from .spbase import SPBase, compute_xbar
+
+_log = logging.getLogger("mpisppy_tpu.ph")
+
+# phase -> telemetry span name, precomputed so the disabled-telemetry
+# hot loop's per-lap cost is a dict read, never a string allocation
+_PHASE_SPAN = {"assemble": "ph.assemble", "solve": "ph.solve",
+               "gate": "ph.gate", "reduce": "ph.reduce"}
+
+
+def _mode_str(key):
+    """Human mode tag for telemetry span args: the solve-mode key of
+    _solve_loop_chunked / solve_loop ((fixed,) prox bool)."""
+    if isinstance(key, tuple):
+        return f"fixed+{'prox' if key[1] else 'noprox'}"
+    return "prox" if key else "noprox"
 
 
 @partial(jax.jit, static_argnames=("w_on", "prox_on"))
@@ -180,7 +199,8 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              w_on, prox_on, slot_slices, sub_max_iter, sub_eps,
              polish_chunk, precision="native", tail_iter=1000,
              sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
-             segment=500, polish_hot=True, segment_lo=None, ir_sweeps=1):
+             segment=500, polish_hot=True, segment_lo=None, ir_sweeps=1,
+             lap=None):
     """The PH iteration: batched subproblem solve + Compute_Xbar +
     Update_W + convergence + objectives + certified dual bound, staged as
     THREE jitted programs (assemble / solve / reduce) rather than one
@@ -200,6 +220,12 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
                              fixed_vals, wscale, w_on=w_on,
                              prox_on=prox_on)
     d = data._replace(lb=bl, ub=bu)
+    if lap is not None:
+        # phase-anatomy hook (telemetry): the fused path books the same
+        # assemble/solve/reduce laps as the chunked loop. Dispatch is
+        # async, so "assemble"/"reduce" book enqueue cost while "solve"
+        # absorbs the device wait (segment iteration readbacks block).
+        lap("assemble")
     qp_state, x, yA, yB = _solver_call(
         factors, d, q, qp_state, prox_on=prox_on, precision=precision,
         sub_max_iter=sub_max_iter, sub_eps=sub_eps,
@@ -207,11 +233,15 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
         tail_iter=tail_iter, stall_rel=stall_rel, segment=segment,
         polish_hot=polish_hot, polish_chunk=polish_chunk,
         segment_lo=segment_lo, ir_sweeps=ir_sweeps)
+    if lap is not None:
+        lap("solve")
     wmask = None if wscale is None else wscale > 0
     (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
      dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
                             memberships, idx, W, rho, wmask, w_on=w_on,
                             slot_slices=slot_slices)
+    if lap is not None:
+        lap("reduce")
     return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
         conv, base_obj, solved_obj, dual_obj
 
@@ -362,6 +392,27 @@ class PHBase(SPBase):
         self._chunk_dirty = set()
         self._spread_cache = {}
         self._phase_times = {}
+
+    # ------------- observability plumbing -------------
+    def _trace_note(self, etype, msg, **fields):
+        """Route a recovery/hospital/standing note through the
+        telemetry event stream and the ``mpisppy_tpu.ph`` logger. The
+        SCREEN print (historically unconditional — these notes fired
+        even with verbose=False) now requires ``verbose`` or an
+        explicit ``hospital_trace=True`` opt-in; headless runs read
+        the JSONL events instead."""
+        obs.event(etype, fields)
+        _log.info(msg)
+        if self.verbose or bool(self.options.get("hospital_trace",
+                                                 False)):
+            global_toc(msg)
+
+    def _trace_consumers_active(self):
+        """Whether anything would consume a recovery/standing note —
+        the gate for host math done only to narrate."""
+        return (self.verbose
+                or bool(self.options.get("hospital_trace", False))
+                or obs.enabled() or _log.isEnabledFor(logging.INFO))
 
     # ------------- solver plumbing -------------
     def _data_with_prox(self, prox_on: bool) -> QPData:
@@ -682,8 +733,8 @@ class PHBase(SPBase):
            residual matrix — a single D2H transfer per PH iteration
            instead of one blocking sync per chunk.
         Per-phase wall-clock and sync counts land in
-        ``phase_timing()`` for the bench/profiling observability."""
-        import time as _time
+        ``phase_timing()`` and, when telemetry is configured (obs),
+        as Chrome-trace spans + counters (doc/observability.md)."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         factors, data = self._get_factors(prox_on, fixed)
         if factors.A_s.ndim != 2:
@@ -718,6 +769,7 @@ class PHBase(SPBase):
             and bool(int(self.options.get("subproblem_donate", 1)))
         if donate:
             self._chunk_dirty.add(key)   # cleared after pass 3 stores
+            obs.counter_add("qp.donated_passes")
         devices = self._spread_devices_for(split_mode) if pipeline else None
         ent = self._phase_times.setdefault(
             key, {"acc": {"assemble": 0.0, "solve": 0.0, "gate": 0.0,
@@ -727,12 +779,21 @@ class PHBase(SPBase):
         ent["calls"] += 1
         ent["devices"] = len(devices) if devices else 1
         gate_syncs = 0
+        # one shared args dict per call (never mutated): lets trace
+        # consumers split phase spans by solve mode, allocated only
+        # when telemetry is on
+        sp_args = {"mode": _mode_str(key)} if obs.enabled() else None
         t_mark = _time.perf_counter()
 
         def _lap(phase):
             nonlocal t_mark
             now = _time.perf_counter()
             acc[phase] += now - t_mark
+            # the span shares _lap's own perf_counter marks, so the
+            # Chrome trace totals are EXACTLY phase_timing's (no-op +
+            # no allocation with telemetry disabled)
+            obs.complete_span(_PHASE_SPAN[phase], t_mark, now, cat="ph",
+                              args=sp_args)
             t_mark = now
 
         # record layout (indices 0-3 are the _hospitalize contract):
@@ -784,8 +845,17 @@ class PHBase(SPBase):
                              put_chunk(d0.lb, dev), put_chunk(d0.ub, dev))
                 q_d = put_chunk(q0, dev)
                 st_in = put_chunk(states[ci], dev)
+                t_c = _time.perf_counter()
                 st, x, yA, yB = _solver_call(fac_d, d_d, q_d, st_in,
                                              donate=donate, **kw)
+                if obs.enabled():
+                    # per-chunk span on a per-device lane: the spread
+                    # renders as parallel tracks in Perfetto
+                    obs.complete_span(
+                        "ph.solve.chunk", t_c, _time.perf_counter(),
+                        cat="ph", args={"chunk": ci, "device": str(dev),
+                                        "mode": sp_args["mode"]},
+                        lane=f"dev{ci % len(devices)}")
                 # outputs ship home (async D2D) for the reductions; the
                 # warm-start state stays resident on its device
                 x, yA, yB = self._home_put((x, yA, yB))
@@ -812,6 +882,7 @@ class PHBase(SPBase):
                     acc["assemble"] += dt_a
                     t_mark += dt_a
                 st_in = states[ci]
+                t_c = _time.perf_counter()
                 if split_mode and prev_st is not None:
                     # df32: chunks FLOW one (rho_scale, factor) pair
                     # through the sequential loop (the in-jit adaptation
@@ -826,6 +897,11 @@ class PHBase(SPBase):
                                            rho_scale=prev_st.rho_scale)
                 st, x, yA, yB = _solver_call(factors, d_c, q_c, st_in,
                                              donate=donate, **kw)
+                if obs.enabled():
+                    obs.complete_span(
+                        "ph.solve.chunk", t_c, _time.perf_counter(),
+                        cat="ph", args={"chunk": ci,
+                                        "mode": sp_args["mode"]})
                 prev_st = st
                 if split_mode:
                     # record a STRIPPED state: keeping each chunk's L
@@ -880,10 +956,12 @@ class PHBase(SPBase):
                 + len(self._hospital_no_retry.get(key, ()))
             self._chunk_no_retry.pop(key, None)
             self._hospital_no_retry.pop(key, None)
-            if self.verbose or self.options.get("hospital_trace", True):
-                global_toc(f"blacklist: re-admitting {nb} entr"
-                           f"{'y' if nb == 1 else 'ies'} for recovery "
-                           f"(every {readmit} solves)")
+            obs.counter_add("ph.blacklist_readmitted", nb)
+            self._trace_note(
+                "ph.blacklist_readmit",
+                f"blacklist: re-admitting {nb} entr"
+                f"{'y' if nb == 1 else 'ies'} for recovery "
+                f"(every {readmit} solves)", count=nb, every=readmit)
         no_retry = self._chunk_no_retry.setdefault(key, set())
         for ci, rec in enumerate(solved_chunks):
             m = float(pri_host[ci].max())
@@ -919,6 +997,10 @@ class PHBase(SPBase):
             pri2 = np.asarray(st2.pri_rel)      # exceptional-path sync
             gate_syncs += 1
             m2 = float(pri2.max())
+            obs.counter_add("ph.chunk_retries")
+            obs.event("ph.chunk_retry",
+                      {"chunk": ci, "nan": is_nan, "pri_rel_before": m,
+                       "pri_rel_after": m2})
             if split_mode:
                 # retry factors are transient too (see the pass-1 strip)
                 st2 = st2._replace(L=jnp.zeros((), jnp.float32))
@@ -966,8 +1048,10 @@ class PHBase(SPBase):
         # above the gate after recovery + hospital enter x̄/W with their
         # loose solutions this iteration — that must be visible in the
         # trace, not only the hospital's treatment log. pri_host was
-        # kept current through passes 2/2b, so this is free host math.
-        if self.verbose or self.options.get("hospital_trace", True):
+        # kept current through passes 2/2b, so this is free host math
+        # (done only when something consumes the note: screen, logger,
+        # or the telemetry event stream).
+        if self._trace_consumers_active():
             standing = []
             for ci, (idx_c, real) in enumerate(slices):
                 pr = pri_host[ci][:real]
@@ -978,11 +1062,16 @@ class PHBase(SPBase):
                 g_w, pr_w = max(standing, key=lambda t: t[1])
                 when = (f"re-admission in {readmit - calls % readmit} "
                         "solves" if readmit else "re-admission disabled")
-                global_toc(
+                obs.counter_add("ph.standing_rows", len(standing))
+                self._trace_note(
+                    "ph.standing",
                     f"standing: {len(standing)} scenario row(s) above "
                     f"pri_rel gate {thr:.0e} enter xbar/W loose "
-                    f"(worst s{g_w}:{pr_w:.0e}; {when})")
+                    f"(worst s{g_w}:{pr_w:.0e}; {when})",
+                    rows=len(standing), gate=thr, worst_scenario=g_w,
+                    worst_pri_rel=pr_w)
         ent["gate_syncs"] += gate_syncs
+        obs.counter_add("ph.gate_syncs", gate_syncs)
         _lap("gate")
         # pass 3 — per-chunk objectives on the accepted solutions
         parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
@@ -1032,6 +1121,7 @@ class PHBase(SPBase):
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
             self.conv = float(conv)
+            obs.gauge_set("ph.conv", self.conv)
         self._last_base_obj = cat["base"]
         self._last_solved_obj = cat["solved"]
         self._last_dual_obj = cat["dual"]
@@ -1040,18 +1130,23 @@ class PHBase(SPBase):
         return cat["solved"]
 
     def reset_phase_timing(self):
-        """Zero the per-phase accumulators (bench timing windows)."""
+        """Zero the per-phase wall-clock accumulators (bench timing
+        windows). Telemetry COUNTERS (obs: ph.gate_syncs and friends)
+        are process-cumulative and deliberately survive this reset —
+        invariant tests read them as pure before/after deltas."""
         self._phase_times.clear()
 
     def phase_timing(self, key=True):
-        """Per-phase wall-clock anatomy of the chunked hot loop for one
-        mode key: mean seconds per solve_loop call in each pipeline
+        """Per-phase wall-clock anatomy of the solve loop for one
+        mode key (chunked or fused — the fused path books assemble/
+        solve/reduce with gate pinned at 0): mean seconds per
+        solve_loop call in each pipeline
         phase (assemble / solve / gate / reduce), the device-busy
         occupancy estimate solve/(total) — the solve phase is the only
         one that blocks on device compute, so everything else is host
         orchestration the pipeline exists to shrink — and the gate's
         D2H sync count per call (the O(chunks) -> O(1) acceptance
-        evidence). Returns None when the key never ran chunked."""
+        evidence). Returns None when the key never ran."""
         ent = self._phase_times.get(key)
         if not ent or not ent["calls"]:
             return None
@@ -1140,12 +1235,17 @@ class PHBase(SPBase):
                    sub_max_iter=max(6000, kw["sub_max_iter"]),
                    segment=1500))
         pr_h = np.asarray(st_h.pri_rel)
-        if self.verbose or self.options.get("hospital_trace", True):
-            worst = " ".join(
-                f"s{g}:{pr_old:.0e}->{pr_h[j]:.0e}"
-                for j, (_, _, g, pr_old) in enumerate(picks))
-            global_toc(f"hospital: treated {len(picks)} scenario(s) "
-                       f"[{worst}]")
+        obs.counter_add("ph.hospital_treated", len(picks))
+        worst = " ".join(
+            f"s{g}:{pr_old:.0e}->{pr_h[j]:.0e}"
+            for j, (_, _, g, pr_old) in enumerate(picks))
+        self._trace_note(
+            "ph.hospital",
+            f"hospital: treated {len(picks)} scenario(s) [{worst}]",
+            treated=len(picks),
+            scenarios=[{"scenario": g, "pri_rel_before": pr_old,
+                        "pri_rel_after": float(pr_h[j])}
+                       for j, (_, _, g, pr_old) in enumerate(picks)])
         for j, (ci, r, g, pr_old) in enumerate(picks):
             if not (pr_h[j] <= thr):
                 # one shot per scenario: an improved-but-uncured row
@@ -1231,8 +1331,8 @@ class PHBase(SPBase):
         selects the eq-boosted factorization for fully-pinned solves.
         With ``subproblem_chunk`` set below S, the solve microbatches
         over scenario chunks (see _solve_loop_chunked)."""
-        import time as _time
         t0 = _time.perf_counter()
+        obs.counter_add("ph.solve_loop_calls")
         chunk = int(self.options.get("subproblem_chunk", 0))
         if chunk and chunk < self.batch.S:
             out = self._solve_loop_chunked(chunk, w_on, prox_on, update,
@@ -1245,6 +1345,29 @@ class PHBase(SPBase):
             return out
         qp_state = self._ensure_state(prox_on, fixed)
         factors, data = self._get_factors(prox_on, fixed)
+        # the fused path books the same per-phase anatomy as the
+        # chunked loop (gate stays 0 — there is no recovery gate here),
+        # so phase_timing()/telemetry spans exist for EVERY engine, not
+        # only chunked ones. t_mark starts after the factor fetch: a
+        # first-call factorization is setup, not iteration anatomy.
+        skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+        ent = self._phase_times.setdefault(
+            skey, {"acc": {"assemble": 0.0, "solve": 0.0, "gate": 0.0,
+                           "reduce": 0.0},
+                   "calls": 0, "gate_syncs": 0, "devices": 1})
+        ent["calls"] += 1
+        acc = ent["acc"]
+        sp_args = {"mode": _mode_str(skey)} if obs.enabled() else None
+        t_mark = _time.perf_counter()
+
+        def _lap(phase):
+            nonlocal t_mark
+            now = _time.perf_counter()
+            acc[phase] += now - t_mark
+            obs.complete_span(_PHASE_SPAN[phase], t_mark, now, cat="ph",
+                              args=sp_args)
+            t_mark = now
+
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
          base_obj, solved_obj, dual_obj) = _ph_step(
             qp_state, factors, data, self.c, self.c0, self.P_diag,
@@ -1262,14 +1385,14 @@ class PHBase(SPBase):
             stall_rel=self.sub_stall_rel, segment=self.sub_segment,
             polish_hot=self.sub_polish_hot,
             segment_lo=self.sub_segment_lo,
-            ir_sweeps=self.sub_ir_sweeps)
-        skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+            ir_sweeps=self.sub_ir_sweeps, lap=_lap)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB
         if update:
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
             self.conv = float(conv)
+            obs.gauge_set("ph.conv", self.conv)
         self._last_base_obj = base_obj
         self._last_solved_obj = solved_obj
         self._last_dual_obj = dual_obj
@@ -1582,6 +1705,7 @@ class PH(PHBase):
         self.trivial_bound = self.Ebound()  # certified wait-and-see bound
         self.update_best_bound(self.trivial_bound)
         self._iter = 0
+        obs.event("ph.iter0", {"trivial_bound": self.trivial_bound})
         self._ext("post_iter0")
         if self.converger_cls is not None:
             self.converger = self.converger_cls(self)
@@ -1606,8 +1730,14 @@ class PH(PHBase):
         # Iter k loop (ref. phbase.py:1472 iterk_loop)
         for it in range(1, self.max_iterations + 1):
             self._iter = it
+            t_it = _time.perf_counter()
             self.solve_loop(w_on=True, prox_on=True)
             self.W = self.W_new
+            if obs.enabled():
+                obs.complete_span("ph.iteration", t_it,
+                                  _time.perf_counter(), cat="ph",
+                                  args={"iter": it})
+                obs.event("ph.iteration", {"iter": it, "conv": self.conv})
             self._ext("miditer")
             if self.spcomm is not None:
                 self.spcomm.sync()

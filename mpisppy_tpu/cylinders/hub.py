@@ -15,7 +15,7 @@ import time
 
 import numpy as np
 
-from .. import global_toc
+from .. import global_toc, obs
 from .spcommunicator import SPCommunicator, Window
 from .spoke import ConvergerSpokeType
 
@@ -32,11 +32,20 @@ class Hub(SPCommunicator):
         self.latest_ob_char = " "
         self.gap_mark_times = {}
         # every best-bound improvement, stamped: (perf_counter, kind,
-        # source char, value). The benchmarks read this to evidence
-        # WHEN each bound source first moved the needle (e.g. the first
+        # source char, value). perf_counter is MONOTONIC — NTP slews
+        # and wall-clock jumps cannot reorder a merge — and
+        # ``clock_anchor`` below pairs one perf_counter reading with
+        # the wall clock so consumers (and the telemetry run header)
+        # can convert. The benchmarks read this to evidence WHEN each
+        # bound source first moved the needle (e.g. the first
         # non-trivial certified outer bound of a device-dual spoke vs
         # the iter-0 trivial seed) — bookkeeping only, no behavior.
         self.bound_events = []
+        self.clock_anchor = {"wall_time_unix": time.time(),
+                             "perf_counter": time.perf_counter()}
+        obs.event("hub.start", {"hub": type(self).__name__,
+                                "spokes": len(self.spokes),
+                                **self.clock_anchor})
         self._trivial_seed = None       # set when the hub seeds "T"
         self._print_rows = 0
         self.extra_checks = bool((options or {}).get("extra_checks", False))
@@ -71,12 +80,18 @@ class Hub(SPCommunicator):
         self.windows_made = True
 
     # ---- bound bookkeeping (ref. hub.py:178-214) ----
+    def _record_bound(self, kind, char, value):
+        t = time.perf_counter()
+        self.bound_events.append((t, kind, char, value))
+        obs.counter_add("hub.bound_updates")
+        obs.event("hub.bound", {"kind": kind, "char": char,
+                                "value": value}, t=t)
+
     def OuterBoundUpdate(self, new_bound, char=" "):
         if new_bound > self.BestOuterBound:
             self.BestOuterBound = new_bound
             self.latest_ob_char = char
-            self.bound_events.append(
-                (time.perf_counter(), "outer", char, float(new_bound)))
+            self._record_bound("outer", char, float(new_bound))
             return True
         return False
 
@@ -84,8 +99,7 @@ class Hub(SPCommunicator):
         if new_bound < self.BestInnerBound:
             self.BestInnerBound = new_bound
             self.latest_ib_char = char
-            self.bound_events.append(
-                (time.perf_counter(), "inner", char, float(new_bound)))
+            self._record_bound("inner", char, float(new_bound))
             return True
         return False
 
@@ -132,6 +146,7 @@ class Hub(SPCommunicator):
             if wid <= self._spoke_last_ids[i]:
                 continue
             self._spoke_last_ids[i] = wid
+            obs.counter_add("hub.window_reads")
             if is_outer and is_inner:
                 self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
                 self.InnerBoundUpdate(values[1], sp.converger_spoke_char)
@@ -159,6 +174,9 @@ class Hub(SPCommunicator):
         for mark in self.options.get("gap_marks", ()):
             if rel_gap <= mark and mark not in self.gap_mark_times:
                 self.gap_mark_times[mark] = time.perf_counter()
+                obs.event("hub.gap_mark",
+                          {"mark": mark, "rel_gap": rel_gap},
+                          t=self.gap_mark_times[mark])
         abs_opt = self.options.get("abs_gap", None)
         rel_opt = self.options.get("rel_gap", None)
         return (abs_opt is not None and abs_gap <= abs_opt) or \
@@ -170,6 +188,15 @@ class Hub(SPCommunicator):
         if getattr(self, "_last_printed", None) == state:
             return
         self._last_printed = state
+        if obs.enabled():
+            ag, rg = self.compute_gaps()
+            fin = lambda v: v if math.isfinite(v) else None  # noqa: E731
+            obs.event("hub.screen_row",
+                      {"iter": it, "outer": fin(self.BestOuterBound),
+                       "inner": fin(self.BestInnerBound),
+                       "abs_gap": fin(ag), "rel_gap": fin(rg),
+                       "ob_char": self.latest_ob_char,
+                       "ib_char": self.latest_ib_char})
         if self._print_rows % 20 == 0:
             global_toc(f"{'Iter.':>5s}  {'Best Bound':>15s}  "
                        f"{'Best Incumbent':>15s}  {'Rel. Gap':>9s}  "
@@ -183,6 +210,7 @@ class Hub(SPCommunicator):
 
     def send_terminate(self):
         """Write-id -1 into every hub-owned window (ref. hub.py:356-368)."""
+        obs.event("hub.terminate", {"spokes": len(self.spokes)})
         for sp in self.spokes:
             sp.hub_window.kill()
 
@@ -266,6 +294,7 @@ class CrossScenarioHub(PHHub):
             if wid == sp.my_window.KILL or wid <= self._spoke_last_ids[i]:
                 continue
             self._spoke_last_ids[i] = wid
+            obs.counter_add("hub.window_reads")
             if np.isnan(values).all():
                 # a process spoke's startup hello (all-NaN payload) —
                 # consumed for readiness, never installed as cuts

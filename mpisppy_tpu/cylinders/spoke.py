@@ -14,6 +14,7 @@ import time
 
 import numpy as np
 
+from .. import obs
 from . import SPOKE_SLEEP_TIME
 from .spcommunicator import SPCommunicator, Window
 
@@ -138,6 +139,14 @@ class _BoundSpoke(Spoke):
     def update_bound(self, value: float):
         self.bound = float(value)
         self._trace.append((time.monotonic(), self.bound))
+        # the telemetry event stream subsumes the CSV trace (one event
+        # type across every spoke kind, monotonic stamps, merged with
+        # the hub's bound events); the CSV stays for trace_prefix users
+        obs.counter_add("spoke.bound_updates")
+        obs.event("spoke.bound",
+                  {"spoke": type(self).__name__,
+                   "char": self.converger_spoke_char,
+                   "value": self.bound})
         if self._trace_path:
             with open(self._trace_path, "a") as f:
                 f.write(f"{self._trace[-1][0]},{self.bound}\n")

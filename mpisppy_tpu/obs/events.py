@@ -1,0 +1,97 @@
+"""Structured JSONL event stream.
+
+One line per event: ``{"t": <perf_counter>, "type": <str>, ...fields}``.
+``t`` is ``time.perf_counter()`` — MONOTONIC, jitter-proof under NTP
+slews — and the stream's first line is a ``run_header`` recording the
+(wall_time_unix, perf_counter) anchor pair plus the run id and a config
+snapshot, so any consumer can convert monotonic stamps to wall clock
+and merge streams from concurrent processes. This stream subsumes the
+historical scatter of per-module sinks: spoke ``trace_prefix`` CSVs,
+hub ``bound_events``, PH hospital/recovery screen traces, and the
+``MPISPPY_TPU_SOLVE_TRACE`` stderr stamps all emit here when telemetry
+is configured (doc/observability.md documents every event type).
+
+Lines are written incrementally (line-buffered append) so a killed run
+keeps everything emitted before the kill; a bounded in-memory tail is
+kept for tests and interactive consumers that never touch the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+
+class EventStream:
+    """Append-only JSONL sink with a bounded in-memory tail."""
+
+    def __init__(self, path=None, run_id=None, config=None, tail=4096):
+        self.path = path
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1) if path else None
+        self.tail = deque(maxlen=tail)
+        self.emitted = 0
+        self.header = {
+            "type": "run_header",
+            "run_id": run_id,
+            "t": time.perf_counter(),
+            "wall_time_unix": time.time(),
+            "wall_time_iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "clock": "perf_counter",
+            "config": config,
+        }
+        self._write(self.header)
+
+    def event(self, etype: str, fields=None, t=None):
+        """Emit one event. ``t`` defaults to now (perf_counter); pass an
+        explicit stamp to record an event measured earlier (e.g. hub
+        bound events re-emitted with their original stamps)."""
+        obj = {"t": time.perf_counter() if t is None else float(t),
+               "type": etype}
+        if fields:
+            obj.update(fields)
+        self._write(obj)
+        return obj
+
+    def _write(self, obj):
+        with self._lock:
+            self.tail.append(obj)
+            self.emitted += 1
+            if self._fh is None:
+                return
+            try:
+                line = json.dumps(obj, default=_jsonable)
+            except ValueError:
+                # unserializable event (e.g. a circular reference the
+                # default hook never sees): drop THIS line only — the
+                # sink must stay alive for every later event
+                return
+            try:
+                self._fh.write(line + "\n")
+            except ValueError:
+                # stream closed under us (interpreter teardown races
+                # the atexit flush) — keep the memory tail
+                self._fh = None
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def _jsonable(o):
+    """Last-resort JSON coercion: numpy scalars/arrays and anything
+    else stringify instead of killing the emitting hot path."""
+    try:
+        import numpy as np
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        if isinstance(o, np.generic):
+            return o.item()
+    except Exception:
+        pass
+    return str(o)

@@ -1,0 +1,103 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The registry is deliberately tiny — a dict per kind under one lock —
+because it sits on the PH hot loop's host path: a counter bump is a
+dict ``get`` + add, a gauge a dict store, and a histogram four scalar
+updates (count/sum/min/max; full bucketing would buy nothing the event
+stream doesn't already record with timestamps). Everything is keyed by
+flat dotted names (``ph.gate_syncs``, ``qp.donated_passes``,
+``hub.window_reads`` — see doc/observability.md for the catalog) so a
+snapshot is directly JSON-serializable.
+
+Counters are cumulative for the process lifetime: they deliberately
+survive ``PHBase.reset_phase_timing`` (which zeroes the *wall-clock*
+accumulators) so invariant tests can read "syncs per solve call" as a
+pure counter ratio without monkeypatching engine internals.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Histogram:
+    """Summary-statistics histogram: count/sum/min/max (+ last)."""
+
+    __slots__ = ("count", "sum", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+
+    def observe(self, value: float):
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        self.last = v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def snapshot(self) -> dict:
+        return {"count": self.count, "sum": self.sum, "min": self.min,
+                "max": self.max, "last": self.last,
+                "mean": (self.sum / self.count) if self.count else None}
+
+
+class MetricsRegistry:
+    """Counters, gauges, and histograms under one lock (hot-loop
+    counter bumps can arrive from the chunk-spreading host threads and
+    the spoke cylinder threads concurrently)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, Histogram] = {}
+
+    def counter_add(self, name: str, n=1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge_set(self, name: str, value: float):
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def histogram_observe(self, name: str, value: float):
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram()
+            h.observe(value)
+
+    def counters_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.counters)
+
+    def counter_get(self, name):
+        with self._lock:
+            return self.counters.get(name, 0)
+
+    def snapshot(self, nonblocking=False):
+        """JSON-ready snapshot of every metric. With ``nonblocking``
+        (signal-handler context: the interrupted frame may HOLD the
+        lock), returns None instead of deadlocking when the lock is
+        unavailable."""
+        if nonblocking:
+            if not self._lock.acquire(blocking=False):
+                return None
+        else:
+            self._lock.acquire()
+        try:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {k: h.snapshot()
+                               for k, h in self.histograms.items()},
+            }
+        finally:
+            self._lock.release()

@@ -1,0 +1,147 @@
+"""Span recording + Chrome trace-event export.
+
+Spans are host wall-clock intervals (``time.perf_counter`` pairs)
+buffered as Chrome trace-event "X" (complete) records and written as
+one ``trace.json`` loadable in Perfetto / chrome://tracing. The PH
+pipeline phases (assemble/solve/gate/reduce), per-chunk solves and
+per-device lanes all land here; lanes map to Chrome ``tid`` so a
+multi-device chunk spread renders as parallel tracks.
+
+Two recording styles:
+ - ``complete(name, t0, t1)`` — the hot-loop style: the caller already
+   holds the perf_counter marks (PH's ``_lap`` accounting), so the span
+   costs one list append and stays EXACTLY consistent with
+   ``PHBase.phase_timing`` (same timestamps, same totals).
+ - ``span(name)`` — a context manager for code that isn't already
+   timing itself. With ``jax_annotations=True`` it also enters a
+   ``jax.profiler.TraceAnnotation`` so host spans line up with XLA
+   device activity inside a ``jax.profiler.trace`` capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class Span:
+    """Context-manager span; records a complete event on exit."""
+
+    __slots__ = ("_buf", "name", "cat", "args", "lane", "_t0", "_ann")
+
+    def __init__(self, buf, name, cat, args, lane, jax_annotation=False):
+        self._buf = buf
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.lane = lane
+        self._t0 = None
+        self._ann = None
+        if jax_annotation:
+            try:
+                from jax.profiler import TraceAnnotation
+                self._ann = TraceAnnotation(name)
+            except Exception:   # profiler unavailable: host span only
+                self._ann = None
+
+    def __enter__(self):
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._buf.complete(self.name, self._t0, t1, cat=self.cat,
+                           args=self.args, lane=self.lane)
+        return False
+
+
+class TraceBuffer:
+    """In-memory Chrome trace-event buffer, flushed to one JSON file."""
+
+    def __init__(self, path=None, run_id=None, jax_annotations=False):
+        self.path = path
+        self.run_id = run_id
+        self.jax_annotations = bool(jax_annotations)
+        self._lock = threading.Lock()
+        self._events = []
+        self._pid = os.getpid()
+        self._lanes = {}          # lane name -> tid + emitted metadata
+        self._meta(self._pid, 0, "process_name",
+                   {"name": f"mpisppy_tpu:{run_id or self._pid}"})
+
+    def _meta(self, pid, tid, name, args):
+        self._events.append({"name": name, "ph": "M", "pid": pid,
+                             "tid": tid, "args": args})
+
+    def _tid(self, lane):
+        """Map a logical lane (None = host thread, str = named track
+        like ``dev0``) to a stable Chrome tid, emitting thread_name
+        metadata on first use."""
+        if lane is None:
+            return threading.get_ident() % 2 ** 31
+        tid = self._lanes.get(lane)
+        if tid is None:
+            tid = self._lanes[lane] = 1 + len(self._lanes)
+            self._meta(self._pid, tid, "thread_name", {"name": str(lane)})
+        return tid
+
+    def complete(self, name, t0, t1, cat="host", args=None, lane=None):
+        """Record a complete ("X") span from explicit perf_counter
+        marks; timestamps convert to the microseconds Chrome expects."""
+        ev = {"name": name, "ph": "X", "cat": cat,
+              "ts": t0 * 1e6, "dur": max(t1 - t0, 0.0) * 1e6,
+              "pid": self._pid}
+        with self._lock:
+            ev["tid"] = self._tid(lane)
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
+
+    def instant(self, name, cat="host", args=None, lane=None):
+        ev = {"name": name, "ph": "i", "s": "t", "cat": cat,
+              "ts": time.perf_counter() * 1e6, "pid": self._pid}
+        with self._lock:
+            ev["tid"] = self._tid(lane)
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
+
+    def span(self, name, cat="host", args=None, lane=None):
+        return Span(self, name, cat, args, lane,
+                    jax_annotation=self.jax_annotations)
+
+    def to_json(self, nonblocking=False):
+        """Trace dict, or None when ``nonblocking`` and the lock is
+        held (signal-handler context: the interrupted frame underneath
+        may own it — blocking there would self-deadlock)."""
+        if nonblocking:
+            if not self._lock.acquire(blocking=False):
+                return None
+        else:
+            self._lock.acquire()
+        try:
+            return {"traceEvents": list(self._events),
+                    "displayTimeUnit": "ms",
+                    "metadata": {"run_id": self.run_id,
+                                 "clock": "perf_counter_us"}}
+        finally:
+            self._lock.release()
+
+    def flush(self, nonblocking=False):
+        """Atomically (re)write the whole trace file. Nonblocking mode
+        skips (returns) when the buffer lock is unavailable."""
+        if self.path is None:
+            return
+        data = self.to_json(nonblocking=nonblocking)
+        if data is None:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.path)

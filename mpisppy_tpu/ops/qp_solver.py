@@ -63,22 +63,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 
-# MPISPPY_TPU_SOLVE_TRACE=1: stderr wall-time stamps per solver segment
-# (each stamp forces a device sync, serializing host work behind device
+
+# MPISPPY_TPU_SOLVE_TRACE=1: wall-time stamps per solver segment (each
+# stamp forces a device sync, serializing host work behind device
 # compute — a measurement tool, never a default). The r4 verdict's MFU
 # question is unanswerable without knowing where a 15-second chunk solve
 # actually spends its time: f32 bulk vs df32 tail vs handoffs.
-_TRACE = bool(int(os.environ.get("MPISPPY_TPU_SOLVE_TRACE", "0") or 0))
+
+
+def _trace_enabled() -> bool:
+    """Re-read the env flag LAZILY on every segment: the historical
+    import-time freeze meant tests (and long-lived processes) could
+    never toggle the trace after the first ``import qp_solver``."""
+    return bool(int(os.environ.get("MPISPPY_TPU_SOLVE_TRACE", "0") or 0))
 
 
 def _trace_seg(tag, t0, state):
-    if _TRACE:
-        jax.block_until_ready(state.x)
-        print(f"[solve-trace] {tag}: {time.perf_counter() - t0:7.3f}s "
-              f"ran={int(state.iters):4d} "
-              f"pri_rel_max={float(jnp.max(state.pri_rel)):.2e}",
-              file=sys.stderr, flush=True)
+    obs.counter_add("qp.solve_segments")
+    if not _trace_enabled():
+        return
+    jax.block_until_ready(state.x)
+    dt = time.perf_counter() - t0
+    iters = int(state.iters)
+    pri = float(jnp.max(state.pri_rel))
+    msg = (f"[solve-trace] {tag}: {dt:7.3f}s ran={iters:4d} "
+           f"pri_rel_max={pri:.2e}")
+    # telemetry first (structured, mergeable), raw stderr second (the
+    # historical greppable form tools already parse)
+    obs.event("qp.solve_segment",
+              {"tag": tag, "seconds": dt, "iters": iters,
+               "pri_rel_max": pri})
+    print(msg, file=sys.stderr, flush=True)
 
 
 class SplitMatrix(NamedTuple):
@@ -1172,6 +1189,7 @@ def _host_adapt_rho(factors: QPFactors, state: QPState) -> QPState:
     # invert only the changed scenarios' KKTs and scatter — a full
     # (S, n, n) host inversion per segment would grow linearly with S
     rows = np.flatnonzero(mask)
+    obs.counter_add("qp.host_rho_refactors", rows.size)
     L_rows = _factorize_host(factors, rho_np, rows=rows)
     return state._replace(rho_scale=rho,
                           L=state.L.at[jnp.asarray(rows)].set(L_rows))

@@ -97,6 +97,10 @@ class RunConfig:
     solve_ef: bool = False           # solve the EF instead of a wheel
     ef_integer: bool = False
     trace_prefix: str | None = None
+    # telemetry output directory (mpisppy_tpu.obs): when set, the run
+    # writes events.jsonl + trace.json + metrics.json there and the
+    # config snapshot lands in the stream's run_header
+    telemetry_dir: str | None = None
 
     def validate(self):
         if self.model not in KNOWN_MODELS:

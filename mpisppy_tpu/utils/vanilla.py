@@ -9,6 +9,7 @@ object the same way, ref. sputils.py:99-108).
 
 from __future__ import annotations
 
+from .. import obs
 from .config import RunConfig, SpokeConfig
 
 _DTYPES = {"float32": "float32", "f32": "float32",
@@ -60,6 +61,9 @@ def build_batch_for(cfg: RunConfig):
     if cfg.num_bundles:
         from ..core.bundles import form_bundles
         batch = form_bundles(batch, cfg.num_bundles)
+    obs.event("batch.build", {"model": cfg.model, "S": batch.S,
+                              "K": batch.K, "n": batch.n,
+                              "shared_A": bool(batch.shared_A)})
     return batch
 
 
@@ -154,6 +158,10 @@ def wheel_dicts(cfg: RunConfig):
     template lowering costs ~a minute, so per-cylinder rebuilds would
     multiply a fixed cost by the wheel width."""
     cfg.validate()
+    obs.event("wheel.build", {"model": cfg.model,
+                              "num_scens": cfg.num_scens,
+                              "hub": cfg.hub,
+                              "spokes": [sp.kind for sp in cfg.spokes]})
     batch = build_batch_for(cfg)
     return hub_dict(cfg, batch=batch), \
         [spoke_dict(cfg, sp, batch=batch) for sp in cfg.spokes]

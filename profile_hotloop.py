@@ -8,6 +8,12 @@ PROFILE_CHUNK=<n> (env) additionally drives the CHUNKED pipelined path
 (assemble / solve / gate / reduce seconds, device-busy occupancy, gate
 D2H syncs per iteration) that the r6 pipelined-dispatch work optimizes
 — the same numbers bench.py records into its uc1024 JSON row.
+
+MPISPPY_TPU_TELEMETRY_DIR=<dir> (env) records the run through the
+unified telemetry layer (mpisppy_tpu.obs): the pipeline phases land as
+Chrome-trace spans in <dir>/trace.json (open in Perfetto — per-device
+lanes show the chunk spread), counters in <dir>/metrics.json, and the
+stamps in <dir>/events.jsonl. See doc/observability.md.
 """
 import os
 import sys
@@ -28,6 +34,9 @@ def main():
     from mpisppy_tpu.utils.runtime import enable_honest_f32
     jax.config.update("jax_enable_x64", True)
     enable_honest_f32()
+
+    from mpisppy_tpu import obs
+    obs.maybe_configure_from_env()   # MPISPPY_TPU_TELEMETRY_DIR
 
     from bench import DF32, INSTANCE
     from mpisppy_tpu.core.ph import PHBase
@@ -77,6 +86,12 @@ def main():
               + f" devices={pt['devices']}")
     pri = float(np.asarray(ph._qp_states[True].pri_rel).max())
     stamp(f"final max pri_rel {pri:.2e}")
+    if obs.enabled():
+        obs.event("profile.final", {"max_pri_rel": pri,
+                                    "phase_timing": pt})
+        obs.shutdown()
+        stamp("telemetry artifacts flushed "
+              f"({os.environ.get('MPISPPY_TPU_TELEMETRY_DIR')})")
 
 
 if __name__ == "__main__":
