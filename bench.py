@@ -90,7 +90,11 @@ def emit(obj):
     """Print a metric line AND persist it to BENCH_partial.json
     atomically — a timeout kill must never erase landed evidence. The
     row also lands in the unified telemetry event stream (bench.metric)
-    so BENCH evidence merges with the run's counters/spans."""
+    so BENCH evidence merges with the run's counters/spans. Every row
+    carries the telemetry schema_version (the same one the run_header
+    stamps) so `analyze --compare` across bench generations can refuse
+    mismatched formats instead of mis-parsing."""
+    obj = dict(obj, schema_version=obs.SCHEMA_VERSION)
     print(json.dumps(obj), flush=True)
     obs.event("bench.metric", obj)
     _EMITTED.append(obj)
@@ -738,6 +742,13 @@ def main():
             import traceback
             _progress(f"PHASE FAILED {name}: {e!r}")
             traceback.print_exc(file=sys.stderr)
+        finally:
+            # HBM watermark gauges + one resource.memory event per
+            # phase boundary (no-op where the backend lacks allocator
+            # stats): OOM postmortems read these from the telemetry
+            # dir instead of re-running with prints
+            from mpisppy_tpu.obs import resource as _obs_resource
+            _obs_resource.sample_memory(event=True)
     _release_device(1024)
     obs.shutdown()   # flush trace.json/metrics.json with the run alive
 

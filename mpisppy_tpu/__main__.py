@@ -12,13 +12,17 @@ Examples:
   python -m mpisppy_tpu uc --num-scens 10 --default-rho 100 \\
       --with-lagrangian --with-xhatshuffle --rel-gap 0.001
   python -m mpisppy_tpu sizes --num-scens 3 --EF --EF-integer
+
+The ``analyze`` subcommand consumes a run's ``--telemetry-dir``
+artifacts instead of launching one (obs/analyze.py; no jax needed):
+  python -m mpisppy_tpu analyze runs/t1
+  python -m mpisppy_tpu analyze --compare runs/base runs/candidate
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import math
 import sys
 
 from .utils.config import (AlgoConfig, RunConfig, SpokeConfig, KNOWN_MODELS,
@@ -117,10 +121,9 @@ def run(cfg: RunConfig):
             wheel = spin_the_wheel(hub_d, spoke_ds)
             # never-established bounds report as null, not
             # JSON-invalid Infinity
-            fin = lambda v: v if v is not None and math.isfinite(v) \
-                else None  # noqa: E731
-            result = {"outer_bound": fin(wheel.hub.BestOuterBound),
-                      "inner_bound": fin(wheel.best_inner_bound)}
+            result = {
+                "outer_bound": obs.finite_or_none(wheel.hub.BestOuterBound),
+                "inner_bound": obs.finite_or_none(wheel.best_inner_bound)}
         obs.event("run.result", result)
         return result
     finally:
@@ -133,6 +136,12 @@ def run(cfg: RunConfig):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "analyze":
+        # diagnostics-only path: reads telemetry artifacts, never
+        # touches jax or the device runtime
+        from .obs.analyze import main as analyze_main
+        return analyze_main(argv[1:])
     args = make_parser().parse_args(argv)
     from .utils.runtime import setup_jax_runtime
 

@@ -38,6 +38,7 @@ import numpy as np
 from .. import global_toc, log as _log_setup, obs  # noqa: F401  (log import
 #   installs the quiet "mpisppy_tpu" root handler the child logger
 #   propagates to)
+from ..obs import resource as _obs_resource
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import (QPData, QPState, qp_setup, qp_solve,
                              qp_solve_mixed, qp_solve_segmented,
@@ -698,9 +699,18 @@ class PHBase(SPBase):
         default device otherwise."""
         if self.mesh is not None:
             from ..parallel.mesh import replicated_sharding
+            if obs.enabled():
+                obs.counter_add("xfer.device_put_bytes",
+                                _obs_resource.put_nbytes(
+                                    tree, lambda a: replicated_sharding(
+                                        self.mesh, a.ndim)))
             return jax.tree.map(
                 lambda a: jax.device_put(
                     a, replicated_sharding(self.mesh, a.ndim)), tree)
+        if obs.enabled():
+            home = jax.devices()[0]
+            obs.counter_add("xfer.device_put_bytes",
+                            _obs_resource.put_nbytes(tree, lambda a: home))
         return jax.device_put(tree, jax.devices()[0])
 
     def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed):
@@ -840,6 +850,15 @@ class PHBase(SPBase):
                 dev = devices[ci % len(devices)]
                 fac_d, A_d, P_d = reps[dev]
                 d0, q0 = inputs[ci]
+                if obs.enabled():
+                    # spread shipping: only leaves NOT already resident
+                    # on this chunk's device count (warm-start states
+                    # stay put after the first wave)
+                    obs.counter_add(
+                        "xfer.device_put_bytes",
+                        _obs_resource.put_nbytes(
+                            (d0.l, d0.u, d0.lb, d0.ub, q0, states[ci]),
+                            lambda a: dev))
                 d_d = QPData(P_d, A_d,
                              put_chunk(d0.l, dev), put_chunk(d0.u, dev),
                              put_chunk(d0.lb, dev), put_chunk(d0.ub, dev))
@@ -938,6 +957,8 @@ class PHBase(SPBase):
             pri_host = np.stack([np.asarray(rec[0].pri_rel)
                                  for rec in solved_chunks])
             gate_syncs += len(solved_chunks)
+        if obs.enabled():
+            obs.counter_add("xfer.d2h_bytes", pri_host.nbytes)
         # blacklist RE-ADMISSION (VERDICT r3 #6): PH moves q every
         # iteration, so a row declared incurable under one (W, x̄) may be
         # easy under a later one; permanent blacklists would freeze its
@@ -996,6 +1017,8 @@ class PHBase(SPBase):
                                              st_r, **kw_r)
             pri2 = np.asarray(st2.pri_rel)      # exceptional-path sync
             gate_syncs += 1
+            if obs.enabled():
+                obs.counter_add("xfer.d2h_bytes", pri2.nbytes)
             m2 = float(pri2.max())
             obs.counter_add("ph.chunk_retries")
             obs.event("ph.chunk_retry",
@@ -1161,6 +1184,72 @@ class PHBase(SPBase):
             "gate_d2h_syncs_per_call": ent["gate_syncs"] / n,
             "devices": ent["devices"],
         }
+
+    def _phase_totals(self):
+        """Accumulated per-phase wall-clock summed over every solve
+        mode — the per-iteration convergence record diffs two of these
+        to attribute one iteration's budget (free host math: four dict
+        reads per mode)."""
+        tot = {"assemble": 0.0, "solve": 0.0, "gate": 0.0, "reduce": 0.0}
+        for ent in self._phase_times.values():
+            for k, v in ent["acc"].items():
+                tot[k] += v
+        return tot
+
+    def residual_summary(self, key=True):
+        """Host summary of the last solve's relative residuals for one
+        mode key (None when that mode never ran). Reading the state
+        syncs a small (S,) vector — callers gate on ``obs.enabled()``;
+        by record-emission time the iteration already synced ``conv``,
+        so this adds a transfer, not a pipeline stall."""
+        st = self._qp_states.get(key)
+        if st is None:
+            return None
+        pri = np.asarray(st.pri_rel)
+        dua = np.asarray(st.dua_rel)
+        return {"pri_rel_max": float(pri.max()),
+                "pri_rel_mean": float(pri.mean()),
+                "dua_rel_max": float(dua.max()),
+                "dua_rel_mean": float(dua.mean())}
+
+    # counters whose per-iteration deltas enter the ph.iteration record
+    # (the recovery machinery volume THIS iteration, plus compile
+    # activity — a nonzero jax.compiles delta mid-run is a retrace)
+    _ITER_DELTA_COUNTERS = ("ph.gate_syncs", "ph.chunk_retries",
+                            "ph.hospital_treated", "ph.standing_rows",
+                            "ph.blacklist_readmitted", "qp.donated_passes",
+                            "qp.solve_segments", "jax.compiles")
+
+    def iteration_record(self, it, seconds, phase_before, counters_before):
+        """The structured per-iteration convergence record (the
+        device-resident analog of the reference's Diagnoser extension):
+        conv, residual summary, best bounds + gap as currently known,
+        this iteration's phase wall-clocks and recovery/compile counter
+        deltas. Emitted as the ``ph.iteration`` event by drivers; only
+        assembled when telemetry is enabled."""
+        fin = obs.finite_or_none
+        rec = {"iter": it, "conv": fin(self.conv), "seconds": seconds,
+               "best_outer": fin(self.best_bound)}
+        if self.spcomm is not None:
+            outer = fin(getattr(self.spcomm, "BestOuterBound", None))
+            inner = fin(getattr(self.spcomm, "BestInnerBound", None))
+            rec["best_outer"] = outer if outer is not None \
+                else rec["best_outer"]
+            rec["best_inner"] = inner
+            if outer is not None and inner is not None and inner != 0:
+                rec["gap_rel"] = (inner - outer) / abs(inner)
+        res = self.residual_summary(True)
+        if res is not None:
+            rec.update(res)
+        now = self._phase_totals()
+        rec["phase_seconds"] = {k: now[k] - phase_before.get(k, 0.0)
+                                for k in now}
+        ctr = obs.counters_snapshot()
+        rec["counter_deltas"] = {
+            k: ctr.get(k, 0) - counters_before.get(k, 0)
+            for k in self._ITER_DELTA_COUNTERS
+            if ctr.get(k, 0) != counters_before.get(k, 0)}
+        return rec
 
     def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,
                      prox_on, kw, pri_host=None):
@@ -1730,14 +1819,26 @@ class PH(PHBase):
         # Iter k loop (ref. phbase.py:1472 iterk_loop)
         for it in range(1, self.max_iterations + 1):
             self._iter = it
+            rec_on = obs.enabled()
+            if rec_on:
+                # snapshots for the per-iteration convergence record:
+                # phase wall-clock totals and the recovery/compile
+                # counters, diffed after the solve
+                pt0 = self._phase_totals()
+                ctr0 = obs.counters_snapshot()
             t_it = _time.perf_counter()
             self.solve_loop(w_on=True, prox_on=True)
             self.W = self.W_new
-            if obs.enabled():
-                obs.complete_span("ph.iteration", t_it,
-                                  _time.perf_counter(), cat="ph",
+            if rec_on:
+                t_end = _time.perf_counter()
+                obs.complete_span("ph.iteration", t_it, t_end, cat="ph",
                                   args={"iter": it})
-                obs.event("ph.iteration", {"iter": it, "conv": self.conv})
+                obs.histogram_observe("ph.iteration_seconds", t_end - t_it)
+                obs.event("ph.iteration", self.iteration_record(
+                    it, t_end - t_it, pt0, ctr0))
+                # device memory watermark gauges (guarded no-op on
+                # backends without allocator stats, e.g. CPU)
+                _obs_resource.sample_memory()
             self._ext("miditer")
             if self.spcomm is not None:
                 self.spcomm.sync()

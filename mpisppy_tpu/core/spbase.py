@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..ir.batch import ScenarioBatch
 from ..ops.qp_solver import QPData
 
@@ -42,16 +43,25 @@ def ship_stacked(a_np, t):
     array (true for structure-shared models, where randomness touches
     a few rhs/bound entries per scenario)."""
     a = np.asarray(a_np)
+    itemsize = np.dtype(t).itemsize
     if a.ndim < 2 or a.nbytes < _SHIP_DENSE_LIMIT:
+        if obs.enabled():
+            obs.counter_add("xfer.h2d_bytes", a.size * itemsize)
         return jnp.asarray(a, t)
     S = a.shape[0]
     flat = a.reshape(S, -1)
     tmpl = flat[0]
     diff = np.flatnonzero((flat != tmpl[None, :]).any(axis=0))
-    itemsize = np.dtype(t).itemsize
     patch_bytes = (tmpl.size + S * diff.size) * itemsize
     if patch_bytes > a.nbytes // 8:
+        if obs.enabled():
+            obs.counter_add("xfer.h2d_bytes", a.size * itemsize)
         return jnp.asarray(a, t)
+    if obs.enabled():
+        # the structure-aware ship moves template + patched columns
+        # only — the whole point on ~1 MB/s tunneled-TPU links; the
+        # counter records what actually crossed
+        obs.counter_add("xfer.h2d_bytes", patch_bytes)
     base = jnp.broadcast_to(jnp.asarray(tmpl, t), flat.shape)
     if diff.size:
         base = base.at[:, jnp.asarray(diff)].set(
@@ -73,6 +83,9 @@ def ship_shared_matrix(A2d, t, split=False):
     sparse_bytes = rows.size * (8 + 4 * n_parts)
     use_scatter = dense_bytes >= _SHIP_DENSE_LIMIT \
         and sparse_bytes < dense_bytes // 8
+    if obs.enabled():
+        obs.counter_add("xfer.h2d_bytes",
+                        sparse_bytes if use_scatter else dense_bytes)
 
     if split:
         from ..ops.packed import analyze_structure

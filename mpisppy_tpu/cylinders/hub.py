@@ -167,6 +167,18 @@ class Hub(SPCommunicator):
 
     def determine_termination(self) -> bool:
         abs_gap, rel_gap = self.compute_gaps()
+        if obs.enabled():
+            # the hub half of the per-iteration convergence record
+            # (ph.iteration is the engine half): bounds + gap as the
+            # wheel sees them EVERY termination check, not only when a
+            # bound moved (hub.screen_row) — analyze reads the pair to
+            # draw one trajectory per run
+            fin = obs.finite_or_none
+            obs.event("hub.iteration",
+                      {"iter": getattr(self.opt, "_iter", None),
+                       "outer": fin(self.BestOuterBound),
+                       "inner": fin(self.BestInnerBound),
+                       "abs_gap": fin(abs_gap), "rel_gap": fin(rel_gap)})
         # rel-gap milestone stamps: the "gap_marks" hub option lists
         # thresholds whose first crossing instant is recorded in
         # self.gap_mark_times (time-to-gap benchmarks read these;
@@ -190,7 +202,7 @@ class Hub(SPCommunicator):
         self._last_printed = state
         if obs.enabled():
             ag, rg = self.compute_gaps()
-            fin = lambda v: v if math.isfinite(v) else None  # noqa: E731
+            fin = obs.finite_or_none
             obs.event("hub.screen_row",
                       {"iter": it, "outer": fin(self.BestOuterBound),
                        "inner": fin(self.BestInnerBound),

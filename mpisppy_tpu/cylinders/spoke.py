@@ -137,6 +137,7 @@ class _BoundSpoke(Spoke):
         self._init_trace("time,bound")
 
     def update_bound(self, value: float):
+        prev_t = self._trace[-1][0] if self._trace else None
         self.bound = float(value)
         self._trace.append((time.monotonic(), self.bound))
         # the telemetry event stream subsumes the CSV trace (one event
@@ -147,6 +148,11 @@ class _BoundSpoke(Spoke):
                   {"spoke": type(self).__name__,
                    "char": self.converger_spoke_char,
                    "value": self.bound})
+        if prev_t is not None:
+            # bound cadence histogram: a spoke that stops publishing
+            # shows up as a p99 spike, not a silent gap in the stream
+            obs.histogram_observe("spoke.bound_interval_seconds",
+                                  self._trace[-1][0] - prev_t)
         if self._trace_path:
             with open(self._trace_path, "a") as f:
                 f.write(f"{self._trace[-1][0]},{self.bound}\n")
@@ -159,6 +165,12 @@ class _BoundSpoke(Spoke):
                 f.write(f"{t},{b}\n")
 
     def finalize(self):
+        # the spoke-side run_footer context: in a multi-process wheel
+        # this lands in the child's role-suffixed event stream just
+        # before its recorder closes
+        obs.event("spoke.finalize",
+                  {"spoke": type(self).__name__, "bound": self.bound,
+                   "updates": len(self._trace)})
         return self.bound
 
 

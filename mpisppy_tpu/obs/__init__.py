@@ -37,10 +37,11 @@ telemetry into that directory without code changes.
 from __future__ import annotations
 
 import atexit
+import math
 import os
 
 from .metrics import Histogram, MetricsRegistry        # noqa: F401
-from .events import EventStream                        # noqa: F401
+from .events import EventStream, SCHEMA_VERSION        # noqa: F401
 from .trace import Span, TraceBuffer                   # noqa: F401
 from .recorder import Recorder                         # noqa: F401
 
@@ -64,25 +65,28 @@ _NULL_SPAN = _NullSpan()
 
 
 def configure(out_dir=None, run_id=None, config=None,
-              jax_annotations=False) -> Recorder:
+              jax_annotations=False, role=None) -> Recorder:
     """Start (or replace) the process-wide telemetry session. The old
     session, if any, is closed first. ``out_dir=None`` records
     in-memory only (events tail + metrics; no files) — useful in tests
-    and interactive sessions."""
+    and interactive sessions. ``role`` suffixes the artifact filenames
+    (``events-<role>.jsonl`` …) so multi-process cylinder runs can
+    share one directory (utils/multiproc.py sets it for spoke
+    children)."""
     global _REC
     if _REC is not None:
         _REC.close()
     _REC = Recorder(out_dir=out_dir, run_id=run_id, config=config,
-                    jax_annotations=jax_annotations)
+                    jax_annotations=jax_annotations, role=role)
     return _REC
 
 
-def maybe_configure_from_env() -> Recorder | None:
+def maybe_configure_from_env(role=None) -> Recorder | None:
     """Enable telemetry when MPISPPY_TPU_TELEMETRY_DIR is set (no-op
     when unset or when a session is already active)."""
     d = os.environ.get("MPISPPY_TPU_TELEMETRY_DIR")
     if d and _REC is None:
-        return configure(out_dir=d)
+        return configure(out_dir=d, role=role)
     return _REC
 
 
@@ -166,3 +170,14 @@ def flush(nonblocking=False):
     r = _REC
     if r is not None:
         r.flush(nonblocking=nonblocking)
+
+
+def finite_or_none(v):
+    """THE sanitizer for bound/gap fields in telemetry events: None for
+    absent or non-finite values (never-established bounds are ±inf,
+    which strict-JSON consumers reject), a plain float otherwise."""
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if math.isfinite(v) else None

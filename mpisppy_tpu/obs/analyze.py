@@ -1,0 +1,543 @@
+"""Post-hoc run diagnostics: ``python -m mpisppy_tpu analyze <dir>``.
+
+The consumer half of the telemetry layer (the Diagnoser-for-artifacts
+the reference ships as a live extension): given a ``--telemetry-dir``
+run directory, render a run report — phase breakdown, convergence and
+bound trajectory, compile/retrace and gate-sync counts, memory
+watermarks, and invariant checks — entirely from the persisted
+artifacts, so production runs are debuggable *after the fact* without
+re-running anything.
+
+``analyze --compare A B`` diffs two runs' headline metrics with
+thresholded verdicts (exit code 3 on REGRESSION), which turns a pair
+of bench telemetry dirs into a CI-checkable artifact. Runs whose
+``run_header.schema`` versions differ are REFUSED (exit code 2)
+instead of mis-parsed — bench.py stamps the same ``schema_version``
+into its BENCH JSON rows for the same reason.
+
+Pure host-side JSON work: no jax import, safe to run anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+from dataclasses import dataclass, field
+
+
+# ---------------- loading ----------------
+
+@dataclass
+class Run:
+    """One telemetry directory, parsed."""
+    path: str
+    header: dict                    # hub run_header (or first role's)
+    events: list = field(default_factory=list)   # all events, hub first
+    roles: dict = field(default_factory=dict)    # role -> its run_header
+    metrics: dict = field(default_factory=dict)  # role ('' = hub) -> snap
+    trace: dict | None = None
+    bad_lines: int = 0
+    # earlier sessions found in a REUSED dir (events.jsonl appends
+    # across runs while trace/metrics overwrite): their events are
+    # dropped so every artifact describes the same — last — run
+    earlier_runs: int = 0
+
+    @property
+    def schema(self) -> int:
+        return int(self.header.get("schema", 1))
+
+    def of(self, etype, role=None):
+        return [e for e in self.events if e.get("type") == etype
+                and (role is None or e.get("_role") == role)]
+
+    def counters(self, role=""):
+        return (self.metrics.get(role) or {}).get("counters", {})
+
+    def gauges(self, role=""):
+        return (self.metrics.get(role) or {}).get("gauges", {})
+
+    def histograms(self, role=""):
+        return (self.metrics.get(role) or {}).get("histograms", {})
+
+
+def _role_of(filename, stem, ext):
+    base = os.path.basename(filename)
+    inner = base[len(stem):-len(ext)]
+    return inner[1:] if inner.startswith("-") else ""
+
+
+def load_run(path) -> Run:
+    """Parse a telemetry directory (hub artifacts + any role-suffixed
+    spoke artifacts). Raises FileNotFoundError when no event stream
+    exists — the one artifact every session writes."""
+    ev_files = sorted(glob.glob(os.path.join(path, "events*.jsonl")),
+                      key=lambda p: (os.path.basename(p) != "events.jsonl",
+                                     p))
+    if not ev_files:
+        raise FileNotFoundError(
+            f"no events*.jsonl under {path!r} — not a telemetry dir? "
+            "(runs write one with --telemetry-dir / "
+            "MPISPPY_TPU_TELEMETRY_DIR)")
+    run = Run(path=path, header={})
+    for f in ev_files:
+        role = _role_of(f, "events", ".jsonl")
+        file_events = []
+        with open(f, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    run.bad_lines += 1
+                    continue
+                e["_role"] = role
+                if e.get("type") == "run_header":
+                    if file_events:
+                        # a REUSED dir: events.jsonl appends across
+                        # sessions while trace/metrics overwrite —
+                        # keep only the LAST session so every
+                        # artifact describes the same run (mixing
+                        # them garbles trajectories and falsely
+                        # fails the monotone-bounds invariant)
+                        run.earlier_runs += 1
+                        file_events = []
+                    run.roles[role] = e
+                file_events.append(e)
+        run.events.extend(file_events)
+        head = run.roles.get(role)
+        if head is not None and (not run.header or role == ""):
+            run.header = head
+    for f in sorted(glob.glob(os.path.join(path, "metrics*.json"))):
+        role = _role_of(f, "metrics", ".json")
+        try:
+            with open(f, encoding="utf-8") as fh:
+                run.metrics[role] = json.load(fh)
+        except ValueError:
+            run.bad_lines += 1
+    if "" not in run.metrics:
+        # a killed run may lack metrics.json; the footer carries the
+        # same snapshot
+        foot = run.of("run_footer", role="")
+        if foot and isinstance(foot[-1].get("metrics"), dict):
+            run.metrics[""] = foot[-1]["metrics"]
+    tr = os.path.join(path, "trace.json")
+    if os.path.exists(tr):
+        try:
+            with open(tr, encoding="utf-8") as fh:
+                run.trace = json.load(fh)
+        except ValueError:
+            run.bad_lines += 1
+    return run
+
+
+# ---------------- derived metrics ----------------
+
+def phase_breakdown(run: Run) -> dict:
+    """{mode: {phase: {"seconds": total, "calls": n}}} from the trace's
+    phase spans; falls back to the per-iteration records' phase deltas
+    (mode-less) when no trace was captured."""
+    phases = ("ph.assemble", "ph.solve", "ph.gate", "ph.reduce")
+    out = {}
+    if run.trace:
+        for ev in run.trace.get("traceEvents", ()):
+            if ev.get("ph") != "X" or ev.get("name") not in phases:
+                continue
+            mode = (ev.get("args") or {}).get("mode", "?")
+            ent = out.setdefault(mode, {})
+            ph = ent.setdefault(ev["name"][3:],
+                                {"seconds": 0.0, "calls": 0})
+            ph["seconds"] += ev.get("dur", 0.0) / 1e6
+            ph["calls"] += 1
+    if not out:
+        for e in run.of("ph.iteration"):
+            ps = e.get("phase_seconds")
+            if not isinstance(ps, dict):
+                continue
+            ent = out.setdefault("(from iteration records)", {})
+            for k, v in ps.items():
+                ph = ent.setdefault(k, {"seconds": 0.0, "calls": 0})
+                ph["seconds"] += v
+                ph["calls"] += 1
+    return out
+
+
+def iteration_rows(run: Run) -> list:
+    """Per-iteration convergence rows (schema-2 ``ph.iteration``
+    records; schema-1 streams carried iter/conv only)."""
+    return [e for e in run.of("ph.iteration") if "iter" in e]
+
+
+def bound_trajectory(run: Run) -> dict:
+    t0 = run.header.get("t", 0.0)
+    traj = {"outer": [], "inner": []}
+    for e in run.of("hub.bound"):
+        kind = e.get("kind")
+        if kind in traj:
+            traj[kind].append((e.get("t", t0) - t0, e.get("char"),
+                               e.get("value")))
+    return traj
+
+
+def memory_watermarks(run: Run) -> dict:
+    """{role: {device: peak_bytes}} from the mem.* gauges."""
+    out = {}
+    for role in run.metrics:
+        devs = {}
+        for name, v in run.gauges(role).items():
+            if name.startswith("mem.") \
+                    and name.endswith(".peak_bytes_in_use"):
+                devs[name.split(".")[1]] = v
+        if devs:
+            out[role] = devs
+    return out
+
+
+def compile_summary(run: Run) -> dict:
+    c = run.counters()
+    h = run.histograms().get("jax.compile_seconds", {})
+    entries = sorted(((k[len("jax.compile.entry."):], v)
+                      for k, v in c.items()
+                      if k.startswith("jax.compile.entry.")),
+                     key=lambda kv: -kv[1])
+    late = [e["iter"] for e in iteration_rows(run)
+            if e.get("counter_deltas", {}).get("jax.compiles")
+            and e["iter"] > 1]
+    return {"compiles": c.get("jax.compiles", 0),
+            "traces": c.get("jax.traces", 0),
+            "compile_seconds_total": h.get("sum", 0.0) or 0.0,
+            "compile_seconds_p99": h.get("p99"),
+            "entries": entries,
+            "late_retrace_iters": late}
+
+
+def invariant_checks(run: Run) -> list:
+    """[(name, ok, detail, severity)] — the afterward-checkable
+    contracts. severity "fail" renders [FAIL] when violated; "warn"
+    renders [WARN] for checks whose violation has benign explanations
+    (counter deltas are process-global, so an in-process spoke
+    thread's legitimate first compile can land inside a hub
+    iteration's window)."""
+    checks = []
+    c = run.counters()
+    calls = c.get("ph.solve_loop_calls", 0)
+    syncs = c.get("ph.gate_syncs", 0)
+    if calls:
+        per = syncs / calls
+        # pipelined chunked mode pays 1/call (+ exceptional retries /
+        # hospital); sequential opt-out pays one per chunk. <= 2 is
+        # the O(1) contract with recovery headroom.
+        checks.append(("gate_syncs_per_solve_call_O1", per <= 2.0,
+                       f"{per:.2f} (ph.gate_syncs {syncs} / "
+                       f"ph.solve_loop_calls {calls})", "fail"))
+    traj = bound_trajectory(run)
+    ok_outer = all(prev[2] <= cur[2] for prev, cur in
+                   zip(traj["outer"], traj["outer"][1:]))
+    ok_inner = all(cur[2] <= prev[2] for prev, cur in
+                   zip(traj["inner"], traj["inner"][1:]))
+    if traj["outer"] or traj["inner"]:
+        checks.append(("bound_updates_monotone", ok_outer and ok_inner,
+                       f"{len(traj['outer'])} outer / "
+                       f"{len(traj['inner'])} inner updates", "fail"))
+    checks.append(("events_parse_clean", run.bad_lines == 0,
+                   f"{run.bad_lines} unparseable line(s)", "fail"))
+    checks.append(("single_run_in_dir", run.earlier_runs == 0,
+                   ("one session" if not run.earlier_runs else
+                    f"{run.earlier_runs} earlier session(s) appended in "
+                    "this dir were ignored (events.jsonl appends across "
+                    "runs; trace/metrics hold only the last) — use a "
+                    "fresh --telemetry-dir per run for full history"),
+                   "warn"))
+    foot = run.of("run_footer", role="")
+    checks.append(("clean_shutdown_footer", bool(foot),
+                   "run_footer present" if foot else
+                   "no run_footer (killed run?)", "fail"))
+    schemas = {int(h.get("schema", 1)) for h in run.roles.values()}
+    checks.append(("schema_consistent_across_roles", len(schemas) <= 1,
+                   f"versions {sorted(schemas)}", "fail"))
+    comp = compile_summary(run)
+    # WARN, not FAIL: compile counters are process-global, so an
+    # in-process spoke thread's legitimate first-time compile can land
+    # inside a hub iteration's delta window (threaded spin_the_wheel)
+    checks.append(("no_late_retraces", not comp["late_retrace_iters"],
+                   ("none" if not comp["late_retrace_iters"] else
+                    f"XLA compiles during iterations "
+                    f"{comp['late_retrace_iters']} — a hot-loop shape/"
+                    "static-arg drift is retracing (or an in-process "
+                    "spoke thread's warmup)"), "warn"))
+    return checks
+
+
+# ---------------- report rendering ----------------
+
+def _fmt_b(n):
+    if n is None:
+        return "-"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} PB"
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def render_report(run: Run) -> str:
+    L = []
+    h = run.header
+    cfg = h.get("config") or {}
+    L.append(f"== run == {run.path}")
+    L.append(f"run_id {h.get('run_id')}  schema {run.schema}  "
+             f"started {h.get('wall_time_iso')}  "
+             f"roles [{', '.join(r or 'hub' for r in sorted(run.roles))}]")
+    if isinstance(cfg, dict) and cfg.get("model"):
+        L.append(f"model {cfg.get('model')}  "
+                 f"num_scens {cfg.get('num_scens')}  "
+                 f"hub {cfg.get('hub')}  "
+                 f"spokes {[s.get('kind') for s in cfg.get('spokes', [])]}")
+    L.append("")
+
+    L.append("== phase breakdown ==")
+    pb = phase_breakdown(run)
+    if pb:
+        for mode, ent in sorted(pb.items()):
+            tot = sum(p["seconds"] for p in ent.values())
+            solve = ent.get("solve", {}).get("seconds", 0.0)
+            occ = solve / tot if tot > 0 else 0.0
+            parts = "  ".join(
+                f"{k} {p['seconds']:.3f}s/{p['calls']}"
+                for k, p in sorted(ent.items()))
+            L.append(f"[{mode}] {parts}  | total {tot:.3f}s "
+                     f"occupancy {occ:.2f}")
+    else:
+        L.append("(no phase spans captured)")
+    L.append("")
+
+    L.append("== convergence trajectory ==")
+    rows = iteration_rows(run)
+    if rows:
+        L.append(f"{'iter':>5} {'conv':>11} {'pri_rel_max':>12} "
+                 f"{'s/iter':>9} {'gap_rel':>10} {'notes'}")
+        shown = rows if len(rows) <= 12 else rows[:6] + rows[-6:]
+        prev_it = None
+        for e in shown:
+            if prev_it is not None and e["iter"] != prev_it + 1:
+                L.append(f"{'...':>5}")
+            prev_it = e["iter"]
+            notes = " ".join(f"{k.split('.')[-1]}={v}" for k, v in
+                             (e.get("counter_deltas") or {}).items()
+                             if not k.startswith("qp.solve_segments")
+                             and not k.startswith("ph.gate_syncs"))
+            L.append(f"{e['iter']:>5} {_fmt(e.get('conv')):>11} "
+                     f"{_fmt(e.get('pri_rel_max')):>12} "
+                     f"{_fmt(e.get('seconds'), 3):>9} "
+                     f"{_fmt(e.get('gap_rel')):>10} {notes}")
+    else:
+        L.append("(no ph.iteration records)")
+    L.append("")
+
+    L.append("== bounds ==")
+    traj = bound_trajectory(run)
+    for kind in ("outer", "inner"):
+        tr = traj[kind]
+        if tr:
+            t_first, ch, v_first = tr[0]
+            t_last, ch_l, v_last = tr[-1]
+            L.append(f"{kind}: {len(tr)} updates, first {_fmt(v_first)} "
+                     f"[{ch}] @ {t_first:.1f}s, best {_fmt(v_last)} "
+                     f"[{ch_l}] @ {t_last:.1f}s")
+        else:
+            L.append(f"{kind}: no updates")
+    hub_it = run.of("hub.iteration")
+    if hub_it:
+        last = hub_it[-1]
+        L.append(f"final gap: rel {_fmt(last.get('rel_gap'))} "
+                 f"abs {_fmt(last.get('abs_gap'))}")
+    L.append("")
+
+    L.append("== resources ==")
+    comp = compile_summary(run)
+    L.append(f"XLA compiles {comp['compiles']} "
+             f"(traces {comp['traces']}, "
+             f"{comp['compile_seconds_total']:.2f}s total)")
+    for name, n in comp["entries"][:8]:
+        L.append(f"  compile x{n}: {name}")
+    mems = memory_watermarks(run)
+    if mems:
+        for role, devs in sorted(mems.items()):
+            row = "  ".join(f"{d}={_fmt_b(v)}"
+                            for d, v in sorted(devs.items()))
+            L.append(f"memory peak [{role or 'hub'}]: {row}")
+    else:
+        L.append("memory: no allocator stats "
+                 "(CPU backend has none — expected off-chip)")
+    c = run.counters()
+    xfer = {k: v for k, v in c.items() if k.startswith("xfer.")}
+    if xfer:
+        L.append("transfers: " + "  ".join(
+            f"{k.split('.', 1)[1]}={_fmt_b(v)}"
+            for k, v in sorted(xfer.items())))
+    L.append("")
+
+    L.append("== counters ==")
+    for k in sorted(c):
+        if k.split(".")[0] in ("ph", "qp", "hub", "spoke"):
+            L.append(f"  {k} = {_fmt(c[k])}")
+    L.append("")
+
+    L.append("== invariant checks ==")
+    for name, ok, detail, severity in invariant_checks(run):
+        tag = "PASS" if ok else severity.upper()
+        L.append(f"  [{tag}] {name}: {detail}")
+    return "\n".join(L)
+
+
+# ---------------- compare ----------------
+
+# (metric, kind): kind "time" uses the time threshold + an absolute
+# floor (sub-millisecond jitter is not a regression), kind "count"
+# uses a fixed 1.25x ratio gate
+_ABS_FLOOR_S = 1e-3
+
+
+def comparison_metrics(run: Run) -> dict:
+    out = {}
+    rows = iteration_rows(run)
+    secs = [e["seconds"] for e in rows if
+            isinstance(e.get("seconds"), (int, float))]
+    if secs:
+        out[("ph_seconds_per_iteration", "time")] = sum(secs) / len(secs)
+    for mode, ent in phase_breakdown(run).items():
+        for ph, p in ent.items():
+            if p["calls"]:
+                out[(f"phase_{ph}_seconds_per_call[{mode}]", "time")] = \
+                    p["seconds"] / p["calls"]
+    c = run.counters()
+    calls = c.get("ph.solve_loop_calls", 0)
+    if calls:
+        out[("gate_syncs_per_solve_call", "count")] = \
+            c.get("ph.gate_syncs", 0) / calls
+        out[("xla_compiles_per_solve_call", "count")] = \
+            c.get("jax.compiles", 0) / calls
+    h = run.histograms().get("ph.iteration_seconds", {})
+    if h.get("p99") is not None:
+        out[("ph_iteration_seconds_p99", "time")] = h["p99"]
+    return out
+
+
+def compare(a: Run, b: Run, threshold=1.5) -> tuple[str, bool]:
+    """Render the A-vs-B diff; returns (text, passed). Raises
+    ValueError on a schema mismatch — two formats must not be
+    numerically compared."""
+    if a.schema != b.schema:
+        raise ValueError(
+            f"schema mismatch: {a.path} is v{a.schema}, {b.path} is "
+            f"v{b.schema} — re-run one side or analyze separately "
+            "(refusing to mis-parse)")
+    ma, mb = comparison_metrics(a), comparison_metrics(b)
+    L = [f"== compare ==\nA: {a.path}\nB: {b.path}\n"
+         f"time regression threshold: {threshold:.2f}x "
+         f"(abs floor {_ABS_FLOOR_S * 1e3:.0f} ms)"]
+    regressions = []
+    for key in sorted(set(ma) & set(mb), key=lambda k: k[0]):
+        name, kind = key
+        va, vb = ma[key], mb[key]
+        ratio = (vb / va) if va > 0 else (math.inf if vb > 0 else 1.0)
+        if kind == "time":
+            bad = ratio > threshold and (vb - va) > _ABS_FLOOR_S
+            better = ratio < 1.0 / threshold and (va - vb) > _ABS_FLOOR_S
+        else:
+            bad = ratio > 1.25 and (vb - va) > 0.5
+            better = ratio < 0.8 and (va - vb) > 0.5
+        tag = ("REGRESSION" if bad else
+               "improved" if better else "ok")
+        if bad:
+            regressions.append(name)
+        L.append(f"  {name}: A={_fmt(va)} B={_fmt(vb)} "
+                 f"ratio={_fmt(ratio, 3)} [{tag}]")
+    only = [k[0] for k in (set(ma) ^ set(mb))]
+    if only:
+        L.append(f"  (not in both runs, skipped: {sorted(only)})")
+    passed = not regressions
+    L.append(f"VERDICT: {'PASS' if passed else 'REGRESSION'}"
+             + (f" ({', '.join(regressions)})" if regressions else ""))
+    return "\n".join(L), passed
+
+
+# ---------------- CLI ----------------
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m mpisppy_tpu analyze",
+        description="Render a diagnostics report from a --telemetry-dir "
+                    "run directory, or diff two runs.")
+    p.add_argument("dirs", nargs="*",
+                   help="one telemetry dir (report) — or two with "
+                        "--compare")
+    p.add_argument("--compare", action="store_true",
+                   help="diff two runs: analyze --compare A B")
+    p.add_argument("--threshold", type=float, default=1.5,
+                   help="time-metric regression ratio (default 1.5)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    try:
+        if args.compare:
+            if len(args.dirs) != 2:
+                print("analyze --compare needs exactly two telemetry "
+                      "dirs")
+                return 2
+            a, b = load_run(args.dirs[0]), load_run(args.dirs[1])
+            try:
+                text, passed = compare(a, b, threshold=args.threshold)
+            except ValueError as e:
+                print(f"analyze: {e}")
+                return 2
+            if args.as_json:
+                print(json.dumps(
+                    {"a": {str(k[0]): v
+                           for k, v in comparison_metrics(a).items()},
+                     "b": {str(k[0]): v
+                           for k, v in comparison_metrics(b).items()},
+                     "verdict": "PASS" if passed else "REGRESSION"}))
+            else:
+                print(text)
+            return 0 if passed else 3
+        if len(args.dirs) != 1:
+            make_parser().print_usage()
+            return 2
+        run = load_run(args.dirs[0])
+        if args.as_json:
+            print(json.dumps({
+                "run_id": run.header.get("run_id"),
+                "schema": run.schema,
+                "phase_breakdown": phase_breakdown(run),
+                "iterations": iteration_rows(run),
+                "counters": run.counters(),
+                "memory": memory_watermarks(run),
+                "compile": {k: v for k, v in compile_summary(run).items()
+                            if k != "entries"},
+                "invariants": [
+                    {"name": n, "ok": ok, "detail": d, "severity": sv}
+                    for n, ok, d, sv in invariant_checks(run)],
+            }))
+        else:
+            print(render_report(run))
+        return 0
+    except FileNotFoundError as e:
+        print(f"analyze: {e}")
+        return 2

@@ -19,15 +19,24 @@ kept for tests and interactive consumers that never touch the disk.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
+
+# Telemetry artifact schema version, stamped into every run_header (and
+# by bench.py into its BENCH JSON rows). Consumers that join artifacts
+# across runs (``analyze --compare``) refuse mismatched versions instead
+# of mis-parsing. Bump when an event/trace/metrics field changes
+# meaning; absent = 1 (the PR-3 format).
+SCHEMA_VERSION = 2
 
 
 class EventStream:
     """Append-only JSONL sink with a bounded in-memory tail."""
 
-    def __init__(self, path=None, run_id=None, config=None, tail=4096):
+    def __init__(self, path=None, run_id=None, config=None, tail=4096,
+                 role=None):
         self.path = path
         self.run_id = run_id
         self._lock = threading.Lock()
@@ -36,10 +45,13 @@ class EventStream:
         self.emitted = 0
         self.header = {
             "type": "run_header",
+            "schema": SCHEMA_VERSION,
             "run_id": run_id,
+            "role": role,
             "t": time.perf_counter(),
             "wall_time_unix": time.time(),
             "wall_time_iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "pid": os.getpid(),
             "clock": "perf_counter",
             "config": config,
         }

@@ -2,10 +2,9 @@
 
 The registry is deliberately tiny — a dict per kind under one lock —
 because it sits on the PH hot loop's host path: a counter bump is a
-dict ``get`` + add, a gauge a dict store, and a histogram four scalar
-updates (count/sum/min/max; full bucketing would buy nothing the event
-stream doesn't already record with timestamps). Everything is keyed by
-flat dotted names (``ph.gate_syncs``, ``qp.donated_passes``,
+dict ``get`` + add, a gauge a dict store, and a histogram a handful of
+scalar updates plus one bisect into a FIXED edge table. Everything is
+keyed by flat dotted names (``ph.gate_syncs``, ``qp.donated_passes``,
 ``hub.window_reads`` — see doc/observability.md for the catalog) so a
 snapshot is directly JSON-serializable.
 
@@ -18,12 +17,26 @@ pure counter ratio without monkeypatching engine internals.
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
+
+# Fixed log-spaced bucket edges for latency histograms: powers of two
+# from ~1 µs to ~4096 s. Fixed (not adaptive) so two runs' snapshots
+# are directly comparable bucket-for-bucket (`analyze --compare`), and
+# so observe() costs one bisect into a shared tuple — no per-histogram
+# allocation, no rebucketing. Span-duration observations land between
+# sub-millisecond fused farmer phases and multi-minute reference-scale
+# chunk solves, hence the wide range.
+BUCKET_EDGES = tuple(2.0 ** e for e in range(-20, 13))
 
 
 class Histogram:
-    """Summary-statistics histogram: count/sum/min/max (+ last)."""
+    """Latency histogram: count/sum/min/max/last plus fixed-edge
+    bucket counts, so ``snapshot()`` can report tail quantiles
+    (p50/p95/p99) and not just means — a recovering chunk retry that
+    doubles one iteration's gate time is invisible in a mean over 100
+    iterations but owns the p99."""
 
-    __slots__ = ("count", "sum", "min", "max", "last")
+    __slots__ = ("count", "sum", "min", "max", "last", "buckets")
 
     def __init__(self):
         self.count = 0
@@ -31,6 +44,11 @@ class Histogram:
         self.min = None
         self.max = None
         self.last = None
+        # buckets[i] counts observations in (BUCKET_EDGES[i-1],
+        # BUCKET_EDGES[i]] — upper-INCLUSIVE, per-bucket counts (NOT
+        # Prometheus-style cumulative); buckets[len(edges)] is the
+        # +inf overflow bucket
+        self.buckets = [0] * (len(BUCKET_EDGES) + 1)
 
     def observe(self, value: float):
         v = float(value)
@@ -41,11 +59,45 @@ class Histogram:
             self.min = v
         if self.max is None or v > self.max:
             self.max = v
+        # bisect_left: an exact-edge value lands in the bucket whose
+        # UPPER edge it equals (upper-inclusive intervals)
+        self.buckets[bisect_left(BUCKET_EDGES, v)] += 1
+
+    def quantile(self, q: float):
+        """Bucket-interpolated quantile in [0, 1]. Exact at the bucket
+        boundaries, linear inside a bucket, clamped to observed
+        min/max (so p50 of a single observation is that observation,
+        not a bucket edge)."""
+        if not self.count:
+            return None
+        rank = q * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            if n == 0:
+                continue
+            if seen + n >= rank:
+                lo = 0.0 if i == 0 else BUCKET_EDGES[i - 1]
+                hi = BUCKET_EDGES[i] if i < len(BUCKET_EDGES) \
+                    else (self.max if self.max is not None else lo)
+                frac = (rank - seen) / n
+                v = lo + (hi - lo) * frac
+                return min(max(v, self.min), self.max)
+            seen += n
+        return self.max
 
     def snapshot(self) -> dict:
+        # keyed by upper edge; per-bucket counts, NOT cumulative (the
+        # name says "upper edge", deliberately not Prometheus's
+        # cumulative "le" convention)
+        nonzero = {f"{BUCKET_EDGES[i]:g}" if i < len(BUCKET_EDGES)
+                   else "+inf": n
+                   for i, n in enumerate(self.buckets) if n}
         return {"count": self.count, "sum": self.sum, "min": self.min,
                 "max": self.max, "last": self.last,
-                "mean": (self.sum / self.count) if self.count else None}
+                "mean": (self.sum / self.count) if self.count else None,
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99),
+                "buckets_upper_edge": nonzero}
 
 
 class MetricsRegistry:

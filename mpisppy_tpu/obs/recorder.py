@@ -12,6 +12,13 @@ a final ``run_footer`` event carrying the metrics snapshot, and closes
 the stream. The module facade (``mpisppy_tpu/obs/__init__.py``) holds
 the process-wide instance; construct Recorders directly only for
 isolated captures (tests).
+
+``role`` names this process's place in a multi-process cylinder run
+(e.g. ``spoke0-lagrangian``): artifacts become ``events-<role>.jsonl``
+/ ``trace-<role>.json`` / ``metrics-<role>.json`` so every process of
+a wheel can write into ONE shared run directory without clobbering the
+hub's un-suffixed files. ``obs/merge.py`` joins the role traces onto
+one wall-clock-aligned timeline after the wheel terminates.
 """
 
 from __future__ import annotations
@@ -25,21 +32,34 @@ from .metrics import MetricsRegistry
 from .trace import TraceBuffer
 
 
+def _suffixed(name, ext, role):
+    return f"{name}-{role}{ext}" if role else f"{name}{ext}"
+
+
 class Recorder:
     def __init__(self, out_dir=None, run_id=None, config=None,
-                 jax_annotations=False):
+                 jax_annotations=False, role=None):
         self.out_dir = out_dir
+        self.role = role
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
         self.run_id = run_id or f"run-{int(time.time())}-{os.getpid()}"
         self.metrics = MetricsRegistry()
         self.events = EventStream(
-            path=os.path.join(out_dir, "events.jsonl") if out_dir else None,
-            run_id=self.run_id, config=config)
+            path=os.path.join(out_dir, _suffixed("events", ".jsonl", role))
+            if out_dir else None,
+            run_id=self.run_id, config=config, role=role)
         self.trace = TraceBuffer(
-            path=os.path.join(out_dir, "trace.json") if out_dir else None,
-            run_id=self.run_id, jax_annotations=jax_annotations)
+            path=os.path.join(out_dir, _suffixed("trace", ".json", role))
+            if out_dir else None,
+            run_id=self.run_id, jax_annotations=jax_annotations, role=role)
         self._closed = False
+        # resource accounting (obs/resource.py): process-global JAX
+        # compile hooks, installed once per process on the first
+        # session — they forward to whatever recorder is active and
+        # no-op when none is
+        from . import resource
+        resource.install()
 
     # thin sink forwarding — these five are the whole hot-path surface
     def event(self, etype, fields=None, t=None):
@@ -72,7 +92,8 @@ class Recorder:
             snap = self.metrics.snapshot(nonblocking=nonblocking)
             if snap is None:
                 return
-            path = os.path.join(self.out_dir, "metrics.json")
+            path = os.path.join(
+                self.out_dir, _suffixed("metrics", ".json", self.role))
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump({"run_id": self.run_id, **snap}, f, indent=1)

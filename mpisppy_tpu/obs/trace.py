@@ -64,16 +64,26 @@ class Span:
 class TraceBuffer:
     """In-memory Chrome trace-event buffer, flushed to one JSON file."""
 
-    def __init__(self, path=None, run_id=None, jax_annotations=False):
+    def __init__(self, path=None, run_id=None, jax_annotations=False,
+                 role=None):
         self.path = path
         self.run_id = run_id
+        self.role = role
         self.jax_annotations = bool(jax_annotations)
         self._lock = threading.Lock()
         self._events = []
         self._pid = os.getpid()
+        # (wall clock, perf_counter) pair read back-to-back: the only
+        # sanctioned way to put this process's monotonic span stamps on
+        # a cross-process timeline (obs/merge.py aligns role traces
+        # from exactly this anchor)
+        self.anchor = {"wall_time_unix": time.time(),
+                       "perf_counter": time.perf_counter()}
         self._lanes = {}          # lane name -> tid + emitted metadata
-        self._meta(self._pid, 0, "process_name",
-                   {"name": f"mpisppy_tpu:{run_id or self._pid}"})
+        name = f"mpisppy_tpu:{run_id or self._pid}"
+        if role:
+            name += f":{role}"
+        self._meta(self._pid, 0, "process_name", {"name": name})
 
     def _meta(self, pid, tid, name, args):
         self._events.append({"name": name, "ph": "M", "pid": pid,
@@ -129,7 +139,9 @@ class TraceBuffer:
             return {"traceEvents": list(self._events),
                     "displayTimeUnit": "ms",
                     "metadata": {"run_id": self.run_id,
-                                 "clock": "perf_counter_us"}}
+                                 "role": self.role,
+                                 "clock": "perf_counter_us",
+                                 **self.anchor}}
         finally:
             self._lock.release()
 

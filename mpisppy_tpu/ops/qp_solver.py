@@ -95,6 +95,10 @@ def _trace_seg(tag, t0, state):
     obs.event("qp.solve_segment",
               {"tag": tag, "seconds": dt, "iters": iters,
                "pri_rel_max": pri})
+    # latency histogram: segment durations are multi-modal (f32 bulk
+    # vs df32 tail vs polish) — the bucketed tails tell them apart
+    # where a mean cannot
+    obs.histogram_observe("qp.solve_segment_seconds", dt)
     print(msg, file=sys.stderr, flush=True)
 
 
@@ -1177,6 +1181,10 @@ def _host_adapt_rho(factors: QPFactors, state: QPState) -> QPState:
     in-jit non-shared branch applies every 4th residual check."""
     pr = np.asarray(state.pri_rel)
     dr = np.asarray(state.dua_rel)
+    if obs.enabled():
+        obs.counter_add("xfer.d2h_bytes",
+                        pr.nbytes + dr.nbytes
+                        + int(state.rho_scale.nbytes))
     ratio = np.sqrt(np.maximum(pr, 1e-30) / np.maximum(dr, 1e-30))
     old = np.asarray(state.rho_scale)
     new = np.clip(old * np.clip(ratio, 1e-6, 1e6), 1e-6, 1e6)
@@ -1191,6 +1199,9 @@ def _host_adapt_rho(factors: QPFactors, state: QPState) -> QPState:
     rows = np.flatnonzero(mask)
     obs.counter_add("qp.host_rho_refactors", rows.size)
     L_rows = _factorize_host(factors, rho_np, rows=rows)
+    if obs.enabled():
+        # nbytes is metadata — no readback of the freshly shipped block
+        obs.counter_add("xfer.h2d_bytes", int(L_rows.nbytes))
     return state._replace(rho_scale=rho,
                           L=state.L.at[jnp.asarray(rows)].set(L_rows))
 

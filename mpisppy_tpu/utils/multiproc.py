@@ -29,10 +29,25 @@ import os
 import secrets
 import time
 
-from .. import global_toc
+from .. import global_toc, obs
 from ..cylinders.spcommunicator import Window
 from ..cylinders.spoke import ConvergerSpokeType
 from .config import RunConfig, config_from_dict
+
+
+def _telemetry_out_dir(cfg):
+    """The run directory spoke children should capture into: the
+    config's explicit ``telemetry_dir`` wins, then a programmatically
+    configured parent session (``obs.configure(out_dir=...)`` with no
+    config field — the path the env-var-only propagation silently
+    dropped), then the env var the spawn children inherit anyway."""
+    d = getattr(cfg, "telemetry_dir", None)
+    if d:
+        return d
+    rec = obs.active()
+    if rec is not None and rec.out_dir:
+        return rec.out_dir
+    return os.environ.get("MPISPPY_TPU_TELEMETRY_DIR") or None
 
 
 class SpokeProxy:
@@ -65,7 +80,8 @@ class SpokeProxy:
         return self._spoke_cls.payload_length(self._S, self._K)
 
 
-def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
+def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
+                  telemetry=None):
     """Runs in the child process: build the engine from the config, wire
     the shared windows, loop until the hub's kill signal.
 
@@ -98,6 +114,19 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
 
     setup_jax_runtime(f32)
 
+    # telemetry capture for THIS cylinder process: role-suffixed
+    # artifacts (events-<role>.jsonl / trace-<role>.json) in the run
+    # directory the hub propagated through the bootstrap — spawned
+    # children share no recorder with the parent, so without this the
+    # spoke's bound events and spans silently vanish. The env-var path
+    # still works when no explicit dir was propagated.
+    from .. import obs as _obs
+    if telemetry and telemetry.get("out_dir"):
+        _obs.configure(out_dir=telemetry["out_dir"],
+                       role=telemetry.get("role"), config=spoke_cfg_dict)
+    elif telemetry:
+        _obs.maybe_configure_from_env(role=telemetry.get("role"))
+
     from .config import SpokeConfig
     from .vanilla import spoke_dict
 
@@ -120,6 +149,11 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32):
         spoke.main()
         spoke.finalize()
     finally:
+        # flush + close this process's telemetry BEFORE the windows
+        # drop, so a hub-side merge running right after the join sees
+        # complete role artifacts (atexit would also flush, but later
+        # than the parent's join returns)
+        _obs.shutdown()
         spoke.hub_window.close(unlink=False)
         spoke.my_window.close(unlink=False)
 
@@ -160,14 +194,20 @@ def spawn_spoke_processes(cfg: RunConfig, run_id, ctx, S, K, f32=False):
     owned_windows); the caller owns window unlink and process joins."""
     from dataclasses import asdict
 
+    tdir = _telemetry_out_dir(cfg)
     proxies, procs, owned = [], [], []
     for i, sp in enumerate(cfg.spokes):
         proxy = _spoke_proxy(sp.kind, run_id, i, S, K, create=True)
         owned += [proxy.hub_window, proxy.my_window]
         proxies.append(proxy)
+        # explicit telemetry propagation (not only the inherited env
+        # var): each child captures into the shared run dir under its
+        # own role so artifacts never clobber
+        telemetry = {"out_dir": tdir, "role": f"spoke{i}-{sp.kind}"}
         p = ctx.Process(target=_spoke_worker,
                         args=(cfg.to_dict(), asdict(sp),
-                              *_spoke_window_names(run_id, i), f32),
+                              *_spoke_window_names(run_id, i), f32,
+                              telemetry),
                         daemon=True)
         p.start()
         procs.append(p)
@@ -204,6 +244,13 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
     cleanly (a forked JAX runtime is unsupported)."""
     cfg.validate()
 
+    # a config-carried telemetry dir enables the parent's session too
+    # (programmatic callers bypass __main__.run, which does this for
+    # the CLI) — the hub's own events/trace must land beside the
+    # spokes' role artifacts for the merge to mean anything
+    if cfg.telemetry_dir and not obs.enabled():
+        obs.configure(out_dir=cfg.telemetry_dir, config=cfg.to_dict())
+
     from .vanilla import hub_dict
 
     hub_d = hub_dict(cfg)
@@ -237,6 +284,20 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
                     p.terminate()
         hub.receive_bounds()
         hub.hub_finalize()
+        tdir = _telemetry_out_dir(cfg)
+        if tdir:
+            # every child flushed its role artifacts before its join
+            # returned; persist the hub's own trace, then merge all
+            # processes onto one wall-clock-aligned Perfetto timeline
+            obs.flush()
+            from ..obs.merge import merge_traces
+            try:
+                merged = merge_traces(tdir)
+                if merged:
+                    global_toc(f"telemetry: merged multi-process trace "
+                               f"-> {merged}")
+            except Exception as e:   # diagnostics must not kill a run
+                global_toc(f"telemetry: trace merge failed: {e!r}")
         return hub
     finally:
         for w in owned:

@@ -59,6 +59,32 @@ class _DummyOpt:
 
 # ---------------- core registry / stream / trace ----------------
 
+def test_histogram_buckets_and_quantiles():
+    """The ISSUE-4 satellite: fixed-edge buckets report tails
+    (p50/p95/p99), not just means — a 5% population of 1 s outliers
+    must own the p99 while the mean sits near the bulk."""
+    from mpisppy_tpu.obs.metrics import Histogram
+
+    h = Histogram()
+    for v in [0.001] * 50 + [0.01] * 45 + [1.0] * 5:
+        h.observe(v)
+    s = h.snapshot()
+    assert s["count"] == 100 and s["min"] == 0.001 and s["max"] == 1.0
+    assert s["p50"] is not None and s["p50"] < 0.004
+    assert 0.005 < s["p95"] < 0.05
+    assert s["p99"] > 0.5          # the outlier tail, invisible in mean
+    assert s["mean"] < 0.06
+    assert sum(s["buckets_upper_edge"].values()) == 100
+    assert len(s["buckets_upper_edge"]) == 3  # three value classes
+    # exact-edge values land in the bucket whose UPPER edge they equal
+    assert s["buckets_upper_edge"]["1"] == 5
+    # single observation: quantiles clamp to the observed value
+    h1 = Histogram()
+    h1.observe(0.42)
+    s1 = h1.snapshot()
+    assert s1["p50"] == s1["p99"] == 0.42
+
+
 def test_metrics_registry_kinds():
     from mpisppy_tpu.obs.metrics import MetricsRegistry
 
@@ -270,6 +296,85 @@ def test_solve_trace_env_reread_lazily(telemetry, monkeypatch):
     assert obs.counter_value("qp.solve_segments") >= len(segs)
 
 
+# ---------------- resource accounting (ISSUE 4 tentpole) ----------
+
+def test_resource_compile_accounting(telemetry):
+    """XLA compiles land as counters, a latency histogram, AND
+    per-jitted-entry attribution — the retrace-visibility contract."""
+    import jax
+
+    rec, _ = telemetry
+    base = obs.counter_value("jax.compiles")
+
+    def _telemetry_probe_fn(x):
+        return (x * 3.0 + 1.0).sum()
+
+    jax.jit(_telemetry_probe_fn)(jnp.arange(7.0)).block_until_ready()
+    assert obs.counter_value("jax.compiles") > base
+    assert obs.counter_value(
+        "jax.compile.entry._telemetry_probe_fn") >= 1
+    ev = [e for e in rec.events.tail if e["type"] == "jax.compile"
+          and e.get("entry") == "_telemetry_probe_fn"]
+    assert ev and ev[0]["seconds"] > 0
+    snap = rec.metrics.snapshot()
+    h = snap["histograms"]["jax.compile_seconds"]
+    assert h["count"] >= 1 and h["p99"] is not None
+    # and the compile books a span on the trace timeline
+    spans = [e for e in rec.trace.to_json()["traceEvents"]
+             if e.get("name") == "jax.compile"]
+    assert spans
+
+
+def test_memory_sampling_guarded_on_cpu(telemetry):
+    """The acceptance guard: resource sampling must be a no-op, not an
+    error, where the backend lacks allocator stats (CPU tier-1)."""
+    from mpisppy_tpu.obs import resource
+
+    assert resource.sample_memory() == {}
+    assert resource.sample_memory(event=True) == {}    # and again
+
+
+def test_transfer_byte_counters(telemetry):
+    """H2D bytes book at batch-shipping sites and D2H bytes at the
+    chunked loop's fused residual gate."""
+    h2d0 = obs.counter_value("xfer.h2d_bytes")
+    ph = PHBase(_uc_batch(8), dict(_OPTS), dtype=jnp.float64)
+    assert obs.counter_value("xfer.h2d_bytes") > h2d0
+    d2h0 = obs.counter_value("xfer.d2h_bytes")
+    ph.solve_loop(w_on=False, prox_on=False)
+    ph.W = ph.W_new
+    assert obs.counter_value("xfer.d2h_bytes") > d2h0
+
+
+def test_iteration_record_schema(telemetry):
+    """The per-iteration convergence record (the device-resident
+    Diagnoser analog): residual summary, phase anatomy that sums to
+    roughly the iteration wall-clock, and counter deltas."""
+    from mpisppy_tpu.core.ph import PH
+    from mpisppy_tpu.ir.batch import build_batch
+
+    rec, _ = telemetry
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(3))
+    ph = PH(batch, {"PHIterLimit": 2, "convthresh": -1.0,
+                    "subproblem_max_iter": 1500})
+    ph.ph_main()
+    its = [e for e in rec.events.tail if e["type"] == "ph.iteration"]
+    assert [e["iter"] for e in its] == [1, 2]
+    for e in its:
+        assert {"conv", "seconds", "best_outer", "pri_rel_max",
+                "pri_rel_mean", "dua_rel_max", "phase_seconds",
+                "counter_deltas"} <= set(e)
+        assert e["conv"] is not None and e["seconds"] > 0
+        ps = e["phase_seconds"]
+        assert set(ps) == {"assemble", "solve", "gate", "reduce"}
+        # phase anatomy is measured inside solve_loop; it must not
+        # exceed the iteration wall-clock that wraps it
+        assert sum(ps.values()) <= e["seconds"] * 1.05 + 1e-3
+    # iteration latency histogram feeds the tail metrics
+    snap = rec.metrics.snapshot()
+    assert snap["histograms"]["ph.iteration_seconds"]["count"] == 2
+
+
 # ---------------- cylinder wiring ----------------
 
 def test_hub_bound_events_monotonic_with_wall_anchor(telemetry):
@@ -287,6 +392,13 @@ def test_hub_bound_events_monotonic_with_wall_anchor(telemetry):
     assert start_ev and start_ev[0]["wall_time_unix"] \
         == hub.clock_anchor["wall_time_unix"]
     assert obs.counter_value("hub.bound_updates") == 2
+    # the hub half of the per-iteration record: bounds + gap on every
+    # termination check
+    hub.determine_termination()
+    it_ev = [e for e in rec.events.tail if e["type"] == "hub.iteration"]
+    assert it_ev and it_ev[-1]["outer"] == -100.0 \
+        and it_ev[-1]["inner"] == 50.0
+    assert it_ev[-1]["abs_gap"] == 150.0
 
 
 def test_spoke_bound_update_emits_event(telemetry):
