@@ -65,6 +65,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "trace-event; load in Perfetto) and "
                         "metrics.json under this directory — see "
                         "doc/observability.md")
+    p.add_argument("--wheel-deadline", type=float, default=None,
+                   help="watchdog: cleanly terminate the wheel after "
+                        "this many seconds (kill signal to spokes, "
+                        "telemetry flushed, partial bounds reported — "
+                        "see doc/fault_tolerance.md)")
     p.add_argument("--f32", action="store_true",
                    help="run in float32 (faster on TPU; bounds and "
                         "objectives carry ~1e-3 relative noise). Default "
@@ -92,6 +97,7 @@ def config_from_args(args) -> RunConfig:
         spokes=spokes, rel_gap=args.rel_gap, abs_gap=args.abs_gap,
         solve_ef=args.solve_ef, ef_integer=args.ef_integer,
         trace_prefix=args.trace_prefix, telemetry_dir=args.telemetry_dir,
+        wheel_deadline=args.wheel_deadline,
     ).validate()
 
 

@@ -11,6 +11,7 @@ each `sync()` (ref. hub.py:417-428).
 from __future__ import annotations
 
 import math
+import threading
 import time
 
 import numpy as np
@@ -49,6 +50,19 @@ class Hub(SPCommunicator):
         self._trivial_seed = None       # set when the hub seeds "T"
         self._print_rows = 0
         self.extra_checks = bool((options or {}).get("extra_checks", False))
+        # supervision (cylinders/supervisor.py): the multi-process
+        # launcher attaches a WheelSupervisor; the sync path polls it
+        self.supervisor = None
+        # wheel watchdog: "wheel_deadline" (seconds from hub start)
+        # terminates a wheel that outlives it — checked on every
+        # termination check, and (process wheels) fired from the
+        # supervisor's timer thread even when the hub is stuck
+        self._wheel_t0 = time.monotonic()
+        self._watchdog_fired = False
+        # the supervisor's timer thread and the hub thread can both
+        # reach fire_watchdog — the once-guard must be atomic
+        self._watchdog_lock = threading.Lock()
+        self._reject_warned = set()     # spokes already WARNed about
 
     # ---- topology (ref. hub.py:245-308 + spcommunicator.py:97) ----
     def classify_spokes(self):
@@ -88,6 +102,16 @@ class Hub(SPCommunicator):
                                 "value": value}, t=t)
 
     def OuterBoundUpdate(self, new_bound, char=" "):
+        # refuse non-finite values outright: a single +inf here would
+        # freeze compute_gaps at (inf, inf) for the rest of the run and
+        # garble the final-bounds report. NaN is the quiet "no value
+        # yet" convention (it loses every comparison anyway); ±inf is
+        # corruption and gets flagged.
+        if not math.isfinite(new_bound):
+            if math.isinf(new_bound):
+                self._reject_bound(None, "outer", char, new_bound,
+                                   "nonfinite")
+            return False
         if new_bound > self.BestOuterBound:
             self.BestOuterBound = new_bound
             self.latest_ob_char = char
@@ -96,12 +120,84 @@ class Hub(SPCommunicator):
         return False
 
     def InnerBoundUpdate(self, new_bound, char=" "):
+        if not math.isfinite(new_bound):
+            if math.isinf(new_bound):
+                self._reject_bound(None, "inner", char, new_bound,
+                                   "nonfinite")
+            return False
         if new_bound < self.BestInnerBound:
             self.BestInnerBound = new_bound
             self.latest_ib_char = char
             self._record_bound("inner", char, float(new_bound))
             return True
         return False
+
+    # ---- ingest validation (the bound-poisoning firewall) ----
+    def _crossed_tol(self, ref):
+        """Tolerance for the crossed-bound corruption test: well above
+        the ~2e-6 relative solve-noise crossings healthy wheels show,
+        far below anything a genuinely corrupt payload lands at."""
+        return float(self.options.get("crossed_bound_tol", 1e-4)) \
+            * (1.0 + abs(ref))
+
+    def _reject_bound(self, spoke, kind, char, value, reason):
+        """Quarantine one payload instead of installing it: counted,
+        evented, reported to the supervisor (enough rejections retire
+        the spoke), never raised — a corrupt spoke must not crash the
+        wheel it failed to poison."""
+        obs.counter_add("hub.bound_rejected")
+        if reason == "crossed":
+            obs.counter_add("hub.bound_crossed")
+        obs.event("hub.bound_rejected",
+                  {"spoke": spoke, "kind": kind, "char": char,
+                   "value": obs.finite_or_none(value), "reason": reason})
+        if spoke not in self._reject_warned:
+            self._reject_warned.add(spoke)
+            global_toc(f"WARNING: rejected {reason} {kind} payload "
+                       f"{value!r} from spoke {spoke} [{char}] "
+                       "(further rejections counted silently)")
+        # a crossed conflict proves SOME bound is corrupt but cannot
+        # attribute which side (the resident bound may be the bad one)
+        # — flag it, but only unambiguous garbage (non-finite,
+        # implausible magnitude) counts toward quarantining the sender
+        if spoke is not None and self.supervisor is not None \
+                and reason != "crossed":
+            self.supervisor.note_rejection(spoke)
+
+    def _ingest_bound(self, i, sp, kind, value):
+        """One validated bound install from spoke ``i``'s window."""
+        v = float(value)
+        if math.isnan(v):
+            return            # "no value yet" (startup hello / one side)
+        char = sp.converger_spoke_char
+        if math.isinf(v):
+            self._reject_bound(i, kind, char, v, "nonfinite")
+            return
+        # implausible magnitude: finite garbage (bit-corrupted doubles,
+        # the injector's 'garbage' mode at ~1e30) would otherwise
+        # install uncontested while the opposite side is still unset
+        # and then poison the crossed-bound test against every
+        # legitimate bound that follows. No real objective approaches
+        # the default cap; models that legitimately do can raise it.
+        if abs(v) > float(self.options.get("bound_magnitude_cap", 1e25)):
+            self._reject_bound(i, kind, char, v, "implausible")
+            return
+        # crossed-bound corruption: in a MIN problem a true outer bound
+        # can never sit above a feasible inner bound (beyond noise)
+        if kind == "outer" and math.isfinite(self.BestInnerBound) \
+                and v > self.BestInnerBound \
+                + self._crossed_tol(self.BestInnerBound):
+            self._reject_bound(i, kind, char, v, "crossed")
+            return
+        if kind == "inner" and math.isfinite(self.BestOuterBound) \
+                and v < self.BestOuterBound \
+                - self._crossed_tol(self.BestOuterBound):
+            self._reject_bound(i, kind, char, v, "crossed")
+            return
+        if kind == "outer":
+            self.OuterBoundUpdate(v, char)
+        else:
+            self.InnerBoundUpdate(v, char)
 
     def first_nontrivial_outer_time(self):
         """perf_counter stamp of the first outer-bound improvement that
@@ -136,7 +232,15 @@ class Hub(SPCommunicator):
         silently lost. A spoke typed BOTH outer and inner (the EF-MIP
         spoke: one B&B yields dual bound AND incumbent) publishes a
         2-value window [outer, inner]; NaN entries mean "no value yet"
-        and lose every bound comparison harmlessly."""
+        and lose every bound comparison harmlessly.
+
+        Every payload passes ingest validation (_ingest_bound): ±inf
+        and crossed bounds are quarantined — counted and evented, never
+        installed (doc/fault_tolerance.md). The supervisor, when one is
+        attached, is polled here too: the sync path IS the wheel's
+        liveness beat."""
+        if self.supervisor is not None:
+            self.supervisor.poll()
         for i, sp in enumerate(self.spokes):
             is_outer = i in self.outer_bound_spoke_indices
             is_inner = i in self.inner_bound_spoke_indices
@@ -147,13 +251,11 @@ class Hub(SPCommunicator):
                 continue
             self._spoke_last_ids[i] = wid
             obs.counter_add("hub.window_reads")
-            if is_outer and is_inner:
-                self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
-                self.InnerBoundUpdate(values[1], sp.converger_spoke_char)
-            elif is_outer:
-                self.OuterBoundUpdate(values[0], sp.converger_spoke_char)
-            else:
-                self.InnerBoundUpdate(values[0], sp.converger_spoke_char)
+            if is_outer:
+                self._ingest_bound(i, sp, "outer", values[0])
+            if is_inner:
+                self._ingest_bound(i, sp, "inner",
+                                   values[1] if is_outer else values[0])
 
     # ---- gap + termination (ref. hub.py:72-137) ----
     def compute_gaps(self):
@@ -165,7 +267,45 @@ class Hub(SPCommunicator):
         rel_gap = abs_gap / nano if nano > 1e-10 else math.inf
         return abs_gap, rel_gap
 
+    # ---- wheel watchdog (doc/fault_tolerance.md) ----
+    def fire_watchdog(self, source):
+        """Deadline exceeded: terminate the wheel CLEANLY — kill signal
+        to every spoke, telemetry flushed, partial bounds evented (the
+        wheel-level analog of bench.py's SIGTERM flush). Once-guarded;
+        callable from the supervisor's timer thread."""
+        with self._watchdog_lock:
+            if self._watchdog_fired:
+                return
+            self._watchdog_fired = True
+        fin = obs.finite_or_none
+        elapsed = time.monotonic() - self._wheel_t0
+        obs.counter_add("hub.watchdog_fired")
+        obs.event("hub.watchdog_fired",
+                  {"source": source, "elapsed": elapsed,
+                   "outer": fin(self.BestOuterBound),
+                   "inner": fin(self.BestInnerBound)})
+        global_toc(f"WARNING: wheel watchdog fired after {elapsed:.1f}s "
+                   f"({source}); terminating with partial bounds "
+                   f"outer {self.BestOuterBound:.6g} / inner "
+                   f"{self.BestInnerBound:.6g}")
+        # nonblocking: the timer thread may interrupt a frame holding a
+        # sink lock (the same contract as bench's signal-handler flush)
+        obs.flush(nonblocking=True)
+        self.send_terminate()
+
+    def _wheel_deadline_exceeded(self) -> bool:
+        if self._watchdog_fired:
+            return True
+        deadline = self.options.get("wheel_deadline")
+        if deadline is not None \
+                and time.monotonic() - self._wheel_t0 > float(deadline):
+            self.fire_watchdog("hub")
+            return True
+        return False
+
     def determine_termination(self) -> bool:
+        if self._wheel_deadline_exceeded():
+            return True
         abs_gap, rel_gap = self.compute_gaps()
         if obs.enabled():
             # the hub half of the per-iteration convergence record
@@ -310,6 +450,13 @@ class CrossScenarioHub(PHHub):
             if np.isnan(values).all():
                 # a process spoke's startup hello (all-NaN payload) —
                 # consumed for readiness, never installed as cuts
+                continue
+            if not np.isfinite(values).all():
+                # cut rows get the same ingest treatment as bounds: a
+                # non-finite coefficient would poison the engine's cut
+                # store — quarantine the payload, keep the wheel
+                self._reject_bound(i, "cuts", sp.converger_spoke_char,
+                                   None, "row_nonfinite")
                 continue
             rows = values.reshape(S, 1 + K)
             self.opt.add_cuts(rows[:, 0], rows[:, 1:])

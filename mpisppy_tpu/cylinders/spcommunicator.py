@@ -93,7 +93,11 @@ class SharedWindow:
         return self.read_id()
 
     def kill(self):
-        self._lib.spw_kill(self._h)
+        # tolerate an already-closed handle: the terminate sweep may
+        # visit a window another path has since retired
+        h = self._h
+        if h:
+            self._lib.spw_kill(h)
 
     def read(self):
         import ctypes

@@ -48,9 +48,19 @@ class Spoke(SPCommunicator):
         self.bound = None
         self._trace = []  # (time, bound) pairs (ref. spoke.py:140-153)
         self._trace_prefix = trace_prefix   # file created by _BoundSpoke
+        # poll cadence / heartbeat knobs, configurable per run so fault
+        # tests can run fast scenarios without monkeypatching the
+        # module constant (RunConfig.spoke_sleep_time plumbs through
+        # the engine options — see utils/vanilla.spoke_dict)
+        self._sleep_time = float(self.options.get("spoke_sleep_time",
+                                                  SPOKE_SLEEP_TIME))
+        self._pulse_interval = float(self.options.get(
+            "spoke_pulse_interval", 1.0))
+        self._last_put = time.monotonic()
 
     # -- wire protocol (ref. spoke.py:59-99) --
     def spoke_to_hub(self, values):
+        self._last_put = time.monotonic()
         self.my_window.put(values)
 
     def spoke_from_hub(self):
@@ -66,12 +76,21 @@ class Spoke(SPCommunicator):
         return True, values
 
     def got_kill_signal(self) -> bool:
-        """Rate-limited kill check (ref. spoke.py:101-111)."""
+        """Rate-limited kill check (ref. spoke.py:101-111). Doubles as
+        the liveness beat: each poll gives ``_heartbeat`` a chance to
+        re-stamp the spoke's window so the supervisor's write-id
+        progress monitoring sees a pulse even when no new bound has
+        been published (doc/fault_tolerance.md)."""
         now = time.monotonic()
-        if now - self._last_kill_check < SPOKE_SLEEP_TIME:
-            time.sleep(SPOKE_SLEEP_TIME)
+        if now - self._last_kill_check < self._sleep_time:
+            time.sleep(self._sleep_time)
         self._last_kill_check = time.monotonic()
+        self._heartbeat()
         return self.killed()
+
+    def _heartbeat(self):
+        """No-op by default; _BoundSpoke re-stamps its window when idle
+        (the write-id doubles as the heartbeat — no extra channel)."""
 
     def killed(self) -> bool:
         """Non-sleeping kill probe for use INSIDE long spoke work
@@ -135,6 +154,24 @@ class _BoundSpoke(Spoke):
     def __init__(self, spbase_object, options=None, trace_prefix=None):
         super().__init__(spbase_object, options, trace_prefix)
         self._init_trace("time,bound")
+
+    def _heartbeat(self):
+        """Idle re-stamp: re-put the current payload (the best bound,
+        or the all-NaN hello when none exists yet) when nothing has
+        been written for a pulse interval. The hub re-reads an
+        identical value harmlessly (it never wins a bound comparison),
+        but the advancing write-id tells the supervisor this spoke is
+        alive even while it computes between publishes."""
+        if self._pulse_interval <= 0 or self.my_window is None:
+            return
+        if time.monotonic() - self._last_put >= self._pulse_interval:
+            # direct window put, NOT spoke_to_hub: pulses must stay
+            # invisible to publish-count semantics (fault-plan
+            # ``at_update`` triggers count real publishes only)
+            self._last_put = time.monotonic()
+            self.my_window.put(np.full(
+                self.local_window_length(),
+                np.nan if self.bound is None else self.bound))
 
     def update_bound(self, value: float):
         prev_t = self._trace[-1][0] if self._trace else None

@@ -215,6 +215,63 @@ def compile_summary(run: Run) -> dict:
             "late_retrace_iters": late}
 
 
+def fault_summary(run: Run) -> dict:
+    """The supervision/ingest-validation story of a run (counters from
+    the hub role, per-spoke detail from the events): downs, respawns,
+    quarantines, rejected payloads, watchdog — and the derived
+    ``degraded`` flag (doc/fault_tolerance.md)."""
+    c = run.counters()
+    downs = run.of("hub.spoke_down")
+    respawns = run.of("hub.spoke_respawn")
+    quars = run.of("hub.spoke_quarantined")
+    rejects = run.of("hub.bound_rejected")
+    watchdog = run.of("hub.watchdog_fired")
+    # supervisor events carry the SPOKE kind ("lagrangian"); rejection
+    # events carry the BOUND kind ("outer"/"inner"/"cuts") — key rows
+    # by spoke index and resolve the spoke kind from the supervisor
+    # events, so one spoke's crashes and rejections land on ONE row
+    spoke_kind = {e.get("spoke"): e.get("kind", "?")
+                  for e in (*downs, *respawns, *quars)
+                  if e.get("spoke") is not None}
+    per_spoke = {}
+    for field_name, evs in (("downs", downs), ("respawns", respawns),
+                            ("quarantined", quars),
+                            ("rejected", rejects)):
+        for e in evs:
+            i = e.get("spoke")
+            key = "hub" if i is None \
+                else f"spoke{i}-{spoke_kind.get(i, '?')}"
+            ent = per_spoke.setdefault(key, {"downs": 0, "respawns": 0,
+                                             "quarantined": 0,
+                                             "rejected": 0,
+                                             "reasons": []})
+            ent[field_name] += 1
+            r = e.get("reason") or e.get("cause")
+            if r and r not in ent["reasons"]:
+                ent["reasons"].append(r)
+    out = {
+        # counters are authoritative when metrics survived; a killed
+        # run falls back to counting the streamed events
+        "downs": int(c.get("hub.spoke_down", 0) or len(downs)),
+        "respawns": int(c.get("hub.spoke_respawn", 0) or len(respawns)),
+        "quarantined": int(c.get("hub.spoke_quarantined", 0)
+                           or len(quars)),
+        "rejected_payloads": int(c.get("hub.bound_rejected", 0)
+                                 or len(rejects)),
+        "crossed_rejections": int(c.get("hub.bound_crossed", 0) or
+                                  sum(1 for e in rejects
+                                      if e.get("reason") == "crossed")),
+        "watchdog_fired": bool(c.get("hub.watchdog_fired", 0)
+                               or watchdog),
+        "watchdog": (watchdog[-1] if watchdog else None),
+        "per_spoke": per_spoke,
+    }
+    out["degraded"] = bool(out["downs"] or out["quarantined"]
+                           or out["rejected_payloads"]
+                           or out["watchdog_fired"])
+    return out
+
+
 def invariant_checks(run: Run) -> list:
     """[(name, ok, detail, severity)] — the afterward-checkable
     contracts. severity "fail" renders [FAIL] when violated; "warn"
@@ -269,6 +326,17 @@ def invariant_checks(run: Run) -> list:
                     f"{comp['late_retrace_iters']} — a hot-loop shape/"
                     "static-arg drift is retracing (or an in-process "
                     "spoke thread's warmup)"), "warn"))
+    # WARN, not FAIL: the wheel is DESIGNED to survive these (that is
+    # the supervisor's whole job), but a quarantined spoke or a
+    # corrupt/crossed payload means the run lost a bound source or
+    # fought corruption — a clean run stays all-PASS
+    f = fault_summary(run)
+    degraded = f["quarantined"] > 0 or f["crossed_rejections"] > 0
+    checks.append(("no_quarantines_or_corruption", not degraded,
+                   ("clean" if not degraded else
+                    f"{f['quarantined']} spoke(s) quarantined, "
+                    f"{f['crossed_rejections']} crossed-bound "
+                    "rejection(s) — see the faults section"), "warn"))
     return checks
 
 
@@ -393,6 +461,32 @@ def render_report(run: Run) -> str:
     for k in sorted(c):
         if k.split(".")[0] in ("ph", "qp", "hub", "spoke"):
             L.append(f"  {k} = {_fmt(c[k])}")
+    L.append("")
+
+    L.append("== faults ==")
+    f = fault_summary(run)
+    if not f["degraded"]:
+        L.append("(none — no spoke downs, respawns, quarantines, "
+                 "rejected payloads, or watchdog)")
+    else:
+        L.append(f"DEGRADED RUN: {f['downs']} down(s), "
+                 f"{f['respawns']} respawn(s), "
+                 f"{f['quarantined']} quarantined, "
+                 f"{f['rejected_payloads']} rejected payload(s) "
+                 f"({f['crossed_rejections']} crossed)")
+        for key, ent in sorted(f["per_spoke"].items()):
+            reasons = f" [{', '.join(ent['reasons'])}]" \
+                if ent["reasons"] else ""
+            L.append(f"  {key}: downs {ent['downs']} "
+                     f"respawns {ent['respawns']} "
+                     f"quarantined {ent['quarantined']} "
+                     f"rejected {ent['rejected']}{reasons}")
+        if f["watchdog_fired"]:
+            w = f["watchdog"] or {}
+            L.append(f"  watchdog fired: source {w.get('source', '?')} "
+                     f"after {_fmt(w.get('elapsed'))}s "
+                     f"(partial bounds outer {_fmt(w.get('outer'))} / "
+                     f"inner {_fmt(w.get('inner'))})")
     L.append("")
 
     L.append("== invariant checks ==")
@@ -531,6 +625,7 @@ def main(argv=None) -> int:
                 "memory": memory_watermarks(run),
                 "compile": {k: v for k, v in compile_summary(run).items()
                             if k != "entries"},
+                "faults": fault_summary(run),
                 "invariants": [
                     {"name": n, "ok": ok, "detail": d, "severity": sv}
                     for n, ok, d, sv in invariant_checks(run)],

@@ -101,6 +101,21 @@ class RunConfig:
     # writes events.jsonl + trace.json + metrics.json there and the
     # config snapshot lands in the stream's run_header
     telemetry_dir: str | None = None
+    # ---- robustness (doc/fault_tolerance.md) ----
+    # wheel watchdog: terminate a wheel that outlives this many seconds
+    # (telemetry flushed, partial bounds reported); None = no deadline
+    wheel_deadline: float | None = None
+    # spoke kill-poll cadence (None = the SPOKE_SLEEP_TIME module
+    # default) and the process-wheel handshake/join deadlines — typed
+    # config instead of module-constant monkeypatching, so fault tests
+    # can run fast scenarios
+    spoke_sleep_time: float | None = None
+    spoke_ready_timeout: float = 300.0
+    join_timeout: float = 120.0
+    # WheelSupervisor options (cylinders/supervisor.KNOWN_OPTIONS):
+    # heartbeat_timeout, max_respawns, respawn_backoff(+_cap),
+    # max_rejections, poll_interval, crossed_bound_tol
+    supervisor: dict = field(default_factory=dict)
 
     def validate(self):
         if self.model not in KNOWN_MODELS:
@@ -118,6 +133,18 @@ class RunConfig:
             raise ValueError("rel_gap must be >= 0")
         if self.abs_gap is not None and not (0 <= self.abs_gap):
             raise ValueError("abs_gap must be >= 0")
+        if self.wheel_deadline is not None and self.wheel_deadline <= 0:
+            raise ValueError("wheel_deadline must be positive")
+        if self.spoke_sleep_time is not None and self.spoke_sleep_time < 0:
+            raise ValueError("spoke_sleep_time must be >= 0")
+        if self.spoke_ready_timeout <= 0 or self.join_timeout <= 0:
+            raise ValueError("spoke_ready_timeout and join_timeout must "
+                             "be positive")
+        from ..cylinders.supervisor import KNOWN_OPTIONS
+        bad = set(self.supervisor) - set(KNOWN_OPTIONS)
+        if bad:
+            raise ValueError(f"unknown supervisor options {sorted(bad)}; "
+                             f"known: {sorted(KNOWN_OPTIONS)}")
         self.algo.validate()
         for sp in self.spokes:
             sp.validate()

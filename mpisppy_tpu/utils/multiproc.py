@@ -139,6 +139,21 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
                                      create=False)
     spoke.my_window = Window.shared(my_name, spoke.local_window_length(),
                                     create=False)
+    # fault injection (testing/faults.py) is gated on an EXPLICIT plan
+    # (spoke option or env var): the import — and every wrapper it
+    # installs — exists only in faulted test children, never on the
+    # production path (tests/test_faults.py asserts the clean path
+    # imports nothing from mpisppy_tpu.testing)
+    fault_spec = opts.get("fault_plan") \
+        or os.environ.get("MPISPPY_TPU_FAULT_PLAN")
+    if fault_spec:
+        from ..testing.faults import FaultInjector
+        injector = FaultInjector.from_spec(
+            fault_spec,
+            index=(telemetry or {}).get("index", 0),
+            gen=(telemetry or {}).get("gen", 0))
+        injector.sleep_before_hello()
+        injector.install(spoke)
     # startup handshake: a NaN hello tells the hub this spoke is wired and
     # looping (the reference's window-size Send/Recv handshake analog,
     # ref. hub.py:285-308). NaN never wins a bound comparison, so the
@@ -158,19 +173,24 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
         spoke.my_window.close(unlink=False)
 
 
-def _spoke_window_names(run_id, i):
-    """THE window naming scheme (creator and opener must agree)."""
-    return f"{run_id}h{i}", f"{run_id}s{i}"
+def _spoke_window_names(run_id, i, gen=0):
+    """THE window naming scheme (creator and opener must agree).
+    ``gen`` > 0 names a respawned incarnation's FRESH pair — a dead
+    generation's windows are never reused (a crashed writer may have
+    died mid-seqlock); they stay in the launcher's owned list and are
+    unlinked at wheel teardown."""
+    suffix = f"r{gen}" if gen else ""
+    return f"{run_id}h{i}{suffix}", f"{run_id}s{i}{suffix}"
 
 
-def _spoke_proxy(kind, run_id, i, S, K, create):
+def _spoke_proxy(kind, run_id, i, S, K, create, gen=0):
     """One spoke's proxy with its window pair, on either side of the
     shm handshake (create=True: wheel launcher; False: a consumer in
     another process, e.g. the sharded-APH hub shard)."""
     from .vanilla import spoke_classes
 
     spoke_cls, _ = spoke_classes(kind)
-    hub_name, my_name = _spoke_window_names(run_id, i)
+    hub_name, my_name = _spoke_window_names(run_id, i, gen)
     proxy = SpokeProxy(spoke_cls, S, K, None, None)
     proxy.hub_window = Window.shared(
         hub_name, proxy.remote_window_length(), create=create)
@@ -186,41 +206,60 @@ def open_spoke_proxies(spoke_kinds, run_id, S, K):
             for i, kind in enumerate(spoke_kinds)]
 
 
+def _spawn_one_spoke(cfg: RunConfig, i, run_id, ctx, S, K, f32, tdir,
+                     gen=0):
+    """Window pair + worker process for ONE spoke (generation ``gen``).
+    The single spawn body shared by the initial launch and the
+    supervisor's respawn path — both incarnations are wired
+    identically, only the window names and the telemetry role carry
+    the generation."""
+    from dataclasses import asdict
+
+    sp = cfg.spokes[i]
+    proxy = _spoke_proxy(sp.kind, run_id, i, S, K, create=True, gen=gen)
+    # explicit telemetry propagation (not only the inherited env var):
+    # each child captures into the shared run dir under its own role
+    # so artifacts never clobber; a respawned incarnation gets a
+    # gen-suffixed role so the dead child's events survive beside it
+    role = f"spoke{i}-{sp.kind}" + (f"-r{gen}" if gen else "")
+    telemetry = {"out_dir": tdir, "role": role, "index": i, "gen": gen}
+    p = ctx.Process(target=_spoke_worker,
+                    args=(cfg.to_dict(), asdict(sp),
+                          *_spoke_window_names(run_id, i, gen), f32,
+                          telemetry),
+                    daemon=True)
+    p.start()
+    return proxy, p
+
+
 def spawn_spoke_processes(cfg: RunConfig, run_id, ctx, S, K, f32=False):
     """Create the window pair + worker process for every spoke in
     ``cfg`` (window names ``{run_id}h{i}`` / ``{run_id}s{i}`` — the ONE
     naming scheme; spin_the_wheel_processes and the sharded-APH wheel
     launcher both spawn through here). Returns (proxies, procs,
     owned_windows); the caller owns window unlink and process joins."""
-    from dataclasses import asdict
-
     tdir = _telemetry_out_dir(cfg)
     proxies, procs, owned = [], [], []
-    for i, sp in enumerate(cfg.spokes):
-        proxy = _spoke_proxy(sp.kind, run_id, i, S, K, create=True)
+    for i in range(len(cfg.spokes)):
+        proxy, p = _spawn_one_spoke(cfg, i, run_id, ctx, S, K, f32, tdir)
         owned += [proxy.hub_window, proxy.my_window]
         proxies.append(proxy)
-        # explicit telemetry propagation (not only the inherited env
-        # var): each child captures into the shared run dir under its
-        # own role so artifacts never clobber
-        telemetry = {"out_dir": tdir, "role": f"spoke{i}-{sp.kind}"}
-        p = ctx.Process(target=_spoke_worker,
-                        args=(cfg.to_dict(), asdict(sp),
-                              *_spoke_window_names(run_id, i), f32,
-                              telemetry),
-                        daemon=True)
-        p.start()
         procs.append(p)
     return proxies, procs, owned
 
 
-def wait_spoke_hellos(cfg: RunConfig, proxies, procs, timeout):
+def wait_spoke_hellos(cfg: RunConfig, proxies, procs, timeout, hub=None):
     """Block until every spoke's startup hello lands (so gap-based
     termination cannot fire before cold-starting spoke processes have
-    joined the wheel)."""
+    joined the wheel). With ``hub`` given, a fired wheel watchdog
+    aborts the wait — the deadline covers startup too."""
     deadline = time.monotonic() + timeout
     for i, proxy in enumerate(proxies):
         while proxy.my_window.read_id() == 0:
+            if hub is not None and hub._watchdog_fired:
+                raise TimeoutError(
+                    "wheel deadline fired while waiting for spoke "
+                    f"hellos (spoke {cfg.spokes[i].kind} still silent)")
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"spoke {cfg.spokes[i].kind} (pid {procs[i].pid}) "
@@ -231,8 +270,8 @@ def wait_spoke_hellos(cfg: RunConfig, proxies, procs, timeout):
             time.sleep(0.05)
 
 
-def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
-                             spoke_ready_timeout=300.0):
+def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
+                             spoke_ready_timeout=None):
     """One hub (this process) + one OS process per spoke. Returns the hub
     after termination; ``hub._spoke_last_ids`` counts consumed updates
     (>= 1 is the startup hello; > 1 means real bound traffic).
@@ -241,8 +280,21 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
     hello before iterating, so a gap-based termination cannot fire before
     cold-starting spoke processes (JAX init + first compile) have joined
     the wheel. The spawn context is used so children re-initialize JAX
-    cleanly (a forked JAX runtime is unsupported)."""
+    cleanly (a forked JAX runtime is unsupported).
+
+    The wheel is SUPERVISED (cylinders/supervisor.py, configured by
+    ``cfg.supervisor``): dead spokes are detected from the hub's sync
+    path and respawned on fresh window pairs with capped backoff,
+    repeat offenders are quarantined while the wheel continues, and
+    ``cfg.wheel_deadline`` arms a watchdog that terminates a hung
+    wheel cleanly (telemetry flushed, partial bounds reported). Both
+    timeouts default from the config (``cfg.join_timeout`` /
+    ``cfg.spoke_ready_timeout``); explicit arguments win."""
     cfg.validate()
+    join_timeout = cfg.join_timeout if join_timeout is None \
+        else join_timeout
+    spoke_ready_timeout = cfg.spoke_ready_timeout \
+        if spoke_ready_timeout is None else spoke_ready_timeout
 
     # a config-carried telemetry dir enables the parent's session too
     # (programmatic callers bypass __main__.run, which does this for
@@ -260,6 +312,7 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
 
     ctx = mp.get_context("spawn")
     proxies, procs, owned = [], [], []
+    supervisor = None
     try:
         proxies, procs, owned = spawn_spoke_processes(cfg, run_id, ctx,
                                                       S, K, f32)
@@ -268,13 +321,33 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
         hub.classify_spokes()
         hub.windows_made = True
         hub.setup_hub()
-        wait_spoke_hellos(cfg, proxies, procs, spoke_ready_timeout)
+        # supervision: liveness + respawn + quarantine polled from the
+        # hub's sync path; the respawner re-enters _spawn_one_spoke on
+        # a generation-suffixed fresh window pair
+        from ..cylinders.supervisor import WheelSupervisor
+
+        tdir = _telemetry_out_dir(cfg)
+
+        def _respawner(i, gen):
+            return _spawn_one_spoke(cfg, i, run_id, ctx, S, K, f32,
+                                    tdir, gen=gen)
+
+        supervisor = WheelSupervisor(
+            proxies, procs, kinds=[sp.kind for sp in cfg.spokes],
+            options=cfg.supervisor, respawner=_respawner, owned=owned)
+        supervisor.attach(hub)
+        if cfg.wheel_deadline:
+            supervisor.start_watchdog(cfg.wheel_deadline)
+        wait_spoke_hellos(cfg, proxies, procs, spoke_ready_timeout,
+                          hub=hub)
         try:
             hub.main()
         finally:
-            # a hub failure must still release the spokes (the in-process
-            # wheel guards the same way, utils/sputils.py) — otherwise the
-            # children poll forever on windows the cleanup unlinks
+            # no respawns once termination starts; then release the
+            # spokes (the in-process wheel guards the same way,
+            # utils/sputils.py) — otherwise the children poll forever
+            # on windows the cleanup unlinks
+            supervisor.shutdown()
             hub.send_terminate()
             for p in procs:
                 p.join(timeout=join_timeout)
@@ -299,6 +372,22 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=120.0, f32=False,
             except Exception as e:   # diagnostics must not kill a run
                 global_toc(f"telemetry: trace merge failed: {e!r}")
         return hub
+    except BaseException:
+        # startup-failure cleanup: a hello timeout (or any raise before
+        # the normal terminate/join path) must not leak live children —
+        # daemon processes would otherwise linger, polling windows the
+        # finally below unlinks, until interpreter exit
+        if supervisor is not None:
+            supervisor.shutdown()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10.0)
+        raise
     finally:
         for w in owned:
             w.close(unlink=True)

@@ -85,6 +85,11 @@ def hub_dict(cfg: RunConfig, batch=None):
         hub_kwargs["options"]["rel_gap"] = cfg.rel_gap
     if cfg.abs_gap is not None:
         hub_kwargs["options"]["abs_gap"] = cfg.abs_gap
+    if cfg.wheel_deadline is not None:
+        hub_kwargs["options"]["wheel_deadline"] = cfg.wheel_deadline
+    if "crossed_bound_tol" in cfg.supervisor:
+        hub_kwargs["options"]["crossed_bound_tol"] = \
+            cfg.supervisor["crossed_bound_tol"]
 
     cross = any(sp.kind == "cross_scenario" for sp in cfg.spokes)
     if cfg.hub == "ph":
@@ -140,6 +145,10 @@ def spoke_dict(cfg: RunConfig, sp: SpokeConfig, batch=None):
     spoke_cls, opt_cls = spoke_classes(sp.kind)
     options = cfg.algo.to_options()
     options.update(sp.options)
+    # run-level spoke knobs (per-spoke options win): the typed config
+    # replaces SPOKE_SLEEP_TIME monkeypatching in fast fault scenarios
+    if cfg.spoke_sleep_time is not None:
+        options.setdefault("spoke_sleep_time", cfg.spoke_sleep_time)
     dtype_kw = _pop_dtype(options)
     spoke_kwargs = {}
     if cfg.trace_prefix:

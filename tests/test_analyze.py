@@ -82,7 +82,8 @@ def test_report_sections_on_farmer_run(farmer_run_dir, capsys):
     out = capsys.readouterr().out
     for section in ("== run ==", "== phase breakdown ==",
                     "== convergence trajectory ==", "== bounds ==",
-                    "== resources ==", "== invariant checks =="):
+                    "== resources ==", "== faults ==",
+                    "== invariant checks =="):
         assert section in out, f"missing section {section}"
     # phase breakdown with per-mode rows and occupancy
     assert "[prox]" in out and "occupancy" in out
@@ -204,6 +205,87 @@ def test_compare_refuses_schema_mismatch(farmer_run_dir, tmp_path,
     rc = analyze.main(["--compare", farmer_run_dir, old])
     assert rc == 2
     assert "schema mismatch" in capsys.readouterr().out
+
+
+# ---------------- faults section (supervised-wheel satellite) --------
+
+def test_faults_section_clean_run_all_pass(farmer_run_dir, capsys):
+    """A clean run: the faults section reads empty, the degraded-run
+    invariant is PASS, and the fault summary is all zeros."""
+    rc = analyze.main([farmer_run_dir])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "(none — no spoke downs" in out
+    assert "DEGRADED RUN" not in out
+    assert "[PASS] no_quarantines_or_corruption: clean" in out
+    run = analyze.load_run(farmer_run_dir)
+    f = analyze.fault_summary(run)
+    assert not f["degraded"] and f["downs"] == 0 \
+        and f["rejected_payloads"] == 0 and not f["watchdog_fired"]
+
+
+def _degraded_dir(tmp_path):
+    """Synthesize a degraded run's artifacts: one spoke died, was
+    respawned, then quarantined; one crossed-bound payload rejected."""
+    d = str(tmp_path / "degraded")
+    os.makedirs(d)
+    events = [
+        {"type": "run_header", "schema": obs.SCHEMA_VERSION, "t": 0.0,
+         "run_id": "deg", "wall_time_unix": 0.0},
+        {"type": "hub.spoke_down", "t": 1.0, "spoke": 0,
+         "kind": "lagrangian", "reason": "died", "exitcode": -9,
+         "crashes": 1},
+        {"type": "hub.spoke_respawn", "t": 2.0, "spoke": 0,
+         "kind": "lagrangian", "gen": 1, "crashes": 1},
+        {"type": "hub.bound_rejected", "t": 3.0, "spoke": 0,
+         "kind": "outer", "char": "L", "value": None,
+         "reason": "crossed"},
+        {"type": "hub.spoke_quarantined", "t": 4.0, "spoke": 0,
+         "kind": "lagrangian", "cause": "crashes", "crashes": 3,
+         "rejections": 1},
+        {"type": "run_footer", "t": 5.0},
+    ]
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(e) for e in events) + "\n")
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump({"counters": {"hub.spoke_down": 1,
+                                "hub.spoke_respawn": 1,
+                                "hub.spoke_quarantined": 1,
+                                "hub.bound_rejected": 1,
+                                "hub.bound_crossed": 1}}, f)
+    return d
+
+
+def test_degraded_run_renders_faults_and_warns(tmp_path, capsys):
+    d = _degraded_dir(tmp_path)
+    rc = analyze.main([d])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DEGRADED RUN: 1 down(s), 1 respawn(s), 1 quarantined" in out
+    assert "spoke0-lagrangian" in out and "died" in out
+    assert "[WARN] no_quarantines_or_corruption" in out
+    assert "[FAIL]" not in out          # degradation is WARN, not FAIL
+    # --json carries the same summary for CI consumers
+    rc = analyze.main([d, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["faults"]["degraded"] is True
+    assert doc["faults"]["quarantined"] == 1
+    assert doc["faults"]["crossed_rejections"] == 1
+    # ONE row per spoke: the crash events (spoke kind "lagrangian")
+    # and the rejection event (bound kind "outer") aggregate together
+    row = doc["faults"]["per_spoke"]["spoke0-lagrangian"]
+    assert row["downs"] == 1 and row["rejected"] == 1
+    assert list(doc["faults"]["per_spoke"]) == ["spoke0-lagrangian"]
+
+
+def test_fault_summary_falls_back_to_events(tmp_path):
+    """A killed run without metrics.json still reports faults from the
+    streamed events."""
+    d = _degraded_dir(tmp_path)
+    os.remove(os.path.join(d, "metrics.json"))
+    f = analyze.fault_summary(analyze.load_run(d))
+    assert f["downs"] == 1 and f["quarantined"] == 1 and f["degraded"]
 
 
 # ---------------- multi-process trace merge ----------------

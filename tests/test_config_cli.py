@@ -48,6 +48,46 @@ def test_parser_builds_config():
     assert cfg.rel_gap == 0.01
 
 
+def test_robustness_config_fields_validate_and_plumb():
+    """The fault-tolerance satellites: wheel_deadline / spoke timing
+    are typed config (doc/fault_tolerance.md), reach the hub options
+    and spoke engine options through vanilla, and reject garbage."""
+    from mpisppy_tpu.utils.vanilla import hub_dict, spoke_dict
+
+    args = make_parser().parse_args(
+        ["farmer", "--wheel-deadline", "120.5", "--with-lagrangian"])
+    cfg = config_from_args(args)
+    assert cfg.wheel_deadline == 120.5
+    cfg = RunConfig(model="farmer", num_scens=3, wheel_deadline=60.0,
+                    spoke_sleep_time=0.002,
+                    spokes=[SpokeConfig(kind="lagrangian")],
+                    supervisor={"max_respawns": 1,
+                                "crossed_bound_tol": 1e-3}).validate()
+    hd = hub_dict(cfg)
+    assert hd["hub_kwargs"]["options"]["wheel_deadline"] == 60.0
+    assert hd["hub_kwargs"]["options"]["crossed_bound_tol"] == 1e-3
+    sd = spoke_dict(cfg, cfg.spokes[0], batch=hd["opt_kwargs"]["batch"])
+    assert sd["opt_kwargs"]["options"]["spoke_sleep_time"] == 0.002
+    # per-spoke option wins over the run-level default
+    cfg2 = RunConfig(model="farmer", num_scens=3, spoke_sleep_time=0.5,
+                     spokes=[SpokeConfig(
+                         kind="lagrangian",
+                         options={"spoke_sleep_time": 0.001})])
+    sd2 = spoke_dict(cfg2, cfg2.spokes[0],
+                     batch=hd["opt_kwargs"]["batch"])
+    assert sd2["opt_kwargs"]["options"]["spoke_sleep_time"] == 0.001
+    # config_from_dict round-trips the new fields (the spawn boundary)
+    from mpisppy_tpu.utils.config import config_from_dict
+    rt = config_from_dict(cfg.to_dict())
+    assert rt.wheel_deadline == 60.0 and rt.supervisor == cfg.supervisor
+    with pytest.raises(ValueError):
+        RunConfig(wheel_deadline=0.0).validate()
+    with pytest.raises(ValueError):
+        RunConfig(spoke_ready_timeout=-1.0).validate()
+    with pytest.raises(ValueError):
+        RunConfig(supervisor={"bogus_knob": 1}).validate()
+
+
 def test_wheel_dicts_cover_every_spoke_kind():
     from mpisppy_tpu.utils.config import KNOWN_SPOKES
 

@@ -6,14 +6,64 @@ Here each spoke is an OS process talking through the C++ seqlock windows
 (ops/native/spwindow); the hub must consume live spoke updates while it
 iterates, and the bound sandwich must hold."""
 
+import os
+import time
+
 import numpy as np
 import pytest
 
 from mpisppy_tpu.cylinders.spcommunicator import Window
 from mpisppy_tpu.utils.config import AlgoConfig, RunConfig, SpokeConfig
-from mpisppy_tpu.utils.multiproc import spin_the_wheel_processes
+from mpisppy_tpu.utils.multiproc import (_spoke_window_names,
+                                         spin_the_wheel_processes)
 
 EF3 = -108390.0
+
+
+def test_window_names_generation_suffix():
+    """Respawn windows are a FRESH generation-suffixed pair; gen 0
+    keeps the historical names (the sharded-APH consumer opens them by
+    the same scheme)."""
+    assert _spoke_window_names("/spwX", 2) == ("/spwXh2", "/spwXs2")
+    assert _spoke_window_names("/spwX", 2, gen=0) == ("/spwXh2", "/spwXs2")
+    assert _spoke_window_names("/spwX", 2, gen=3) \
+        == ("/spwXh2r3", "/spwXs2r3")
+
+
+def test_startup_timeout_reaps_children_and_windows():
+    """The startup-failure leak fix: when wait_spoke_hellos times out,
+    spin_the_wheel_processes must terminate/join every spawned child
+    and unlink every window before re-raising — daemon children must
+    not linger until interpreter exit."""
+    import multiprocessing as mp
+
+    cfg = RunConfig(
+        model="farmer", num_scens=3,
+        spokes=[SpokeConfig(kind="lagrangian")],
+        rel_gap=0.5,
+        # a child cannot finish its cold JAX start this fast, so the
+        # hello wait deterministically times out
+        spoke_ready_timeout=0.5,
+    )
+    before_pids = {p.pid for p in mp.active_children()}
+    shm = "/dev/shm"
+    shm_before = set(os.listdir(shm)) if os.path.isdir(shm) else set()
+    with pytest.raises(TimeoutError):
+        spin_the_wheel_processes(cfg)
+    # every child this wheel spawned is dead and reaped
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        leftover = [p for p in mp.active_children()
+                    if p.pid not in before_pids and p.is_alive()]
+        if not leftover:
+            break
+        time.sleep(0.2)
+    assert not leftover, f"leaked children: {leftover}"
+    # ...and the shm windows were unlinked
+    if os.path.isdir(shm):
+        new = {f for f in os.listdir(shm)
+               if f.startswith("spw")} - shm_before
+        assert not new, f"leaked windows: {new}"
 
 
 def test_shared_window_protocol():
