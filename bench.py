@@ -336,7 +336,15 @@ def bench_1024():
         "phase_seconds_per_iter": {
             k: round(v, 3) for k, v in per_call.items()},
         "gate_d2h_syncs_per_iter": pt.get("gate_d2h_syncs_per_call"),
-        "spread_devices": pt.get("devices", 1),
+        # scenario-axis sharding anatomy (ISSUE 6): mode is "host" on
+        # one device, "sharded" when the engine runs SPMD over a mesh
+        # (the >1-device default — doc/sharding.md)
+        "sharding": {
+            "mode": pt.get("mode", "host"),
+            "n_devices": pt.get("devices", 1),
+            "shard_size": (ph._shard_ops.shard_size
+                           if ph._shard_ops is not None else S),
+        },
         "packed_matvec_mbytes_per_pass": pk_mb,
         "telemetry_counters_timed_window": ctr_window,
     })

@@ -74,6 +74,20 @@ def make_parser() -> argparse.ArgumentParser:
                    help="run in float32 (faster on TPU; bounds and "
                         "objectives carry ~1e-3 relative noise). Default "
                         "is float64 for solver-grade accuracy.")
+    # scenario-axis sharding + multi-host (doc/sharding.md)
+    p.add_argument("--mesh-devices", type=int, default=None,
+                   help="shard the hub engine's scenario axis over this "
+                        "many devices (0 = all visible devices); the PH "
+                        "step runs SPMD with psum reductions")
+    p.add_argument("--coordinator-address", type=str, default=None,
+                   help="host:port of process 0 for multi-process JAX "
+                        "(jax.distributed.initialize) — the wheel then "
+                        "spans hosts over DCN")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="process count for --coordinator-address "
+                        "(omit on TPU pods: self-discovered)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's id for --coordinator-address")
     return p
 
 
@@ -90,6 +104,18 @@ def config_from_args(args) -> RunConfig:
     )
     spokes = [SpokeConfig(kind=k) for k in KNOWN_SPOKES
               if getattr(args, f"with_{k}")]
+    # build the dict whenever ANY coordinator flag is present, so
+    # --num-processes without --coordinator-address hits validate()'s
+    # "coordinator needs an 'address'" error instead of silently
+    # running single-process
+    coordinator = None
+    if (args.coordinator_address or args.num_processes is not None
+            or args.process_id is not None):
+        coordinator = {"address": args.coordinator_address}
+        if args.num_processes is not None:
+            coordinator["num_processes"] = args.num_processes
+        if args.process_id is not None:
+            coordinator["process_id"] = args.process_id
     return RunConfig(
         model=args.model, num_scens=args.num_scens,
         model_kwargs=json.loads(args.model_kwargs),
@@ -98,12 +124,17 @@ def config_from_args(args) -> RunConfig:
         solve_ef=args.solve_ef, ef_integer=args.ef_integer,
         trace_prefix=args.trace_prefix, telemetry_dir=args.telemetry_dir,
         wheel_deadline=args.wheel_deadline,
+        mesh_devices=args.mesh_devices, coordinator=coordinator,
     ).validate()
 
 
 def run(cfg: RunConfig):
     from . import global_toc, obs
+    from .utils.runtime import maybe_init_distributed
 
+    # multi-process JAX must come up BEFORE the backend initializes
+    # (engine construction below touches devices)
+    maybe_init_distributed(cfg.coordinator)
     # telemetry session: --telemetry-dir wins; otherwise the
     # MPISPPY_TPU_TELEMETRY_DIR env var can enable it without flags
     if cfg.telemetry_dir:

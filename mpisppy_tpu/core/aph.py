@@ -137,19 +137,26 @@ class APH(PHBase):
 
     # ---- dispatch selection (ref. aph.py:592-640 _dispatch_list) ----
     def _dispatch_mask(self, it, frac):
+        """Zero-probability mesh pad rows (core/spbase padding for
+        uneven shards) are excluded from both the dispatch budget and
+        the candidate pools: their phis are identically zero and the
+        least-recently-dispatched fill would otherwise burn real
+        dispatch slots re-solving dummy copies."""
         S = self.batch.S
-        scnt = max(1, int(np.ceil(S * frac)))
-        if scnt >= S:
-            return np.ones(S, bool)
-        phis = np.asarray(self.phis)
+        S_real = self._S_orig
+        scnt = max(1, int(np.ceil(S_real * frac)))
         mask = np.zeros(S, bool)
+        if scnt >= S_real:
+            mask[:S_real] = True
+            return mask
+        phis = np.asarray(self.phis)[:S_real]
         neg = np.flatnonzero(phis < 0)
         take = neg[np.argsort(phis[neg])][:scnt]
         mask[take] = True
         short = scnt - take.size
         if short > 0:
             # least-recently-dispatched fill, phi as implicit tie-break
-            rest = np.flatnonzero(~mask)
+            rest = np.flatnonzero(~mask[:S_real])
             oldest = rest[np.argsort(self._last_dispatch[rest],
                                      kind="stable")][:short]
             mask[oldest] = True

@@ -201,7 +201,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              polish_chunk, precision="native", tail_iter=1000,
              sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
              segment=500, polish_hot=True, segment_lo=None, ir_sweeps=1,
-             lap=None):
+             lap=None, combine_fn=None):
     """The PH iteration: batched subproblem solve + Compute_Xbar +
     Update_W + convergence + objectives + certified dual bound, staged as
     THREE jitted programs (assemble / solve / reduce) rather than one
@@ -237,10 +237,19 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
     if lap is not None:
         lap("solve")
     wmask = None if wscale is None else wscale > 0
-    (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
-     dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
-                            memberships, idx, W, rho, wmask, w_on=w_on,
-                            slot_slices=slot_slices)
+    if combine_fn is None:
+        (xn, xbar_new, xsqbar_new, W_new, conv, base_obj, solved_obj,
+         dual_obj) = _ph_reduce(x, yA, yB, d, q, c, c0, P0, prob, xbar_w,
+                                memberships, idx, W, rho, wmask, w_on=w_on,
+                                slot_slices=slot_slices)
+    else:
+        # sharded engines: the membership matmuls are replaced by the
+        # explicit segment-sum + psum combine (parallel/mesh
+        # ShardedScenarioOps) — same math, collective spelling
+        xn, base_obj, solved_obj, dual_obj = _ph_chunk_objs(
+            x, yA, yB, d, q, c, c0, P0, idx, W, w_on=w_on)
+        xbar_new, xsqbar_new, W_new, conv = combine_fn(
+            xn, prob, xbar_w, W, rho, wmask)
     if lap is not None:
         lap("reduce")
     return qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, \
@@ -259,21 +268,22 @@ class _ChunkStateView:
     _FIELDS = ("x", "yA", "yB", "zA", "zB", "pri_res", "dua_res",
                "pri_rel", "dua_rel")
 
-    def __init__(self, states, trims, precomputed=None):
+    def __init__(self, states, trims, precomputed=None, concat_fn=None):
         self._states = list(states)
         self._trims = list(trims)
+        # sharded chunks reassemble through the mesh's local concat
+        # (chunk rows are strided over devices); host-chunked states
+        # concatenate plainly
+        self._concat = concat_fn
         for k, v in (precomputed or {}).items():
             setattr(self, k, v)
 
     def __getattr__(self, name):
         if name in _ChunkStateView._FIELDS:
-            from ..parallel.mesh import colocate
-            # multi-device chunk spreading leaves each chunk's state
-            # committed to its round-robin device; concatenation needs
-            # one placement, so colocate onto the first chunk's device
-            val = jnp.concatenate(colocate(
-                [getattr(s, name)[:r]
-                 for s, r in zip(self._states, self._trims)]))
+            parts = [getattr(s, name)[:r]
+                     for s, r in zip(self._states, self._trims)]
+            val = jnp.concatenate(parts) if self._concat is None \
+                else self._concat(parts)
             setattr(self, name, val)
             return val
         raise AttributeError(name)
@@ -381,8 +391,7 @@ class PHBase(SPBase):
         # pipelined chunk dispatch (see _solve_loop_chunked): per-mode
         # donation eligibility (a key enters after its first completed
         # pass — before that, chunk states share cold-state buffers and
-        # donating one chunk's would delete its siblings'), the
-        # per-device replication cache for chunk spreading, and the
+        # donating one chunk's would delete its siblings') and the
         # per-phase wall-clock/sync accounting the bench and tests read
         self._chunk_donatable = set()
         # modes whose donating pass is in flight: set before pass 1
@@ -391,8 +400,19 @@ class PHBase(SPBase):
         # states referencing DELETED arrays, and the next call must
         # rebuild cold instead of warm-starting from them
         self._chunk_dirty = set()
-        self._spread_cache = {}
         self._phase_times = {}
+        # 0/False were the documented "disable spreading" spellings of
+        # the retired option — nothing changed for those configs, so
+        # only values that used to alter behavior warn
+        if opts.get("subproblem_spread_devices") not in (
+                None, "auto", 0, "0", False):
+            import warnings
+            warnings.warn(
+                "subproblem_spread_devices is retired: multi-device "
+                "runs shard the scenario axis over the mesh instead of "
+                "round-robin chunk spreading (doc/sharding.md) — pass "
+                "mesh=make_mesh(n); the option is ignored",
+                DeprecationWarning, stacklevel=2)
 
     # ------------- observability plumbing -------------
     def _trace_note(self, etype, msg, **fields):
@@ -534,12 +554,11 @@ class PHBase(SPBase):
         self._blacklist_calls.clear()
         # chunk-plumbing caches ride the factor lifetime: rebuilt chunk
         # states start from shared cold buffers again (donation must
-        # re-earn eligibility), spread replicas hold the OLD factors,
-        # and the index cache — keyed by (chunk, S) so a mutated batch
-        # can never silently reuse stale slices — resets with them
+        # re-earn eligibility), and the index cache — keyed by
+        # (chunk, S) so a mutated batch can never silently reuse stale
+        # slices — resets with them
         self._chunk_donatable.clear()
         self._chunk_dirty.clear()
-        self._spread_cache.clear()
         getattr(self, "_chunk_idx_cache", {}).clear()
 
     def _ensure_state(self, prox_on=True, fixed=False):
@@ -612,11 +631,16 @@ class PHBase(SPBase):
             self._chunk_idx_cache[(chunk, S)] = out
         return self._chunk_idx_cache[(chunk, S)]
 
-    def _ensure_chunk_states(self, key, factors, data, slices):
+    def _ensure_chunk_states(self, key, factors, data, slices,
+                             chunks=None, lc=None):
         """Per-chunk QPStates (each owns its L / rho_scale trajectory —
         cross-chunk sharing would let one chunk's rho adaptation corrupt
         another's warm start). Authoritative store for chunked mode;
         self._qp_states[key] holds a concatenated read-only view.
+
+        ``chunks``/``lc`` (sharded mode): the pre-chunked operand store
+        from _chunked_inputs — cold states and warm-start transplants
+        slice it locally instead of gathering strided global indices.
 
         New modes transplant iterates from any existing mode's
         concatenated view, exactly like _ensure_state: a cold prox-off
@@ -634,84 +658,77 @@ class PHBase(SPBase):
             # shapes are identical), and immutable buffers make the
             # sharing safe — at df32 scale each per-chunk factor copy
             # would cost ~0.7 GB x chunk count
-            idx0 = slices[0][0]
-            st0 = qp_cold_state(factors, data._replace(
-                l=data.l[idx0], u=data.u[idx0],
-                lb=data.lb[idx0], ub=data.ub[idx0]))
-            for idx, _ in slices:
+            if chunks is not None:
+                d0 = data._replace(l=chunks["l"][0], u=chunks["u"][0],
+                                   lb=chunks["lb"][0], ub=chunks["ub"][0])
+            else:
+                idx0 = slices[0][0]
+                d0 = data._replace(l=data.l[idx0], u=data.u[idx0],
+                                   lb=data.lb[idx0], ub=data.ub[idx0])
+            st0 = qp_cold_state(factors, d0)
+            oth_ch = None
+            transplant = other is not None \
+                and other.x.shape[0] == self.batch.S \
+                and other.zA.shape[1] == st0.zA.shape[1]
+            if transplant and chunks is not None:
+                oth_ch = self._shard_ops.to_chunks(
+                    {"x": other.x, "yA": other.yA, "yB": other.yB,
+                     "zA": other.zA, "zB": other.zB}, lc)
+            for ci, (idx, _) in enumerate(slices):
                 st = st0
-                if other is not None and \
-                        other.x.shape[0] == self.batch.S and \
-                        other.zA.shape[1] == st.zA.shape[1]:
-                    st = st._replace(x=other.x[idx], yA=other.yA[idx],
-                                     yB=other.yB[idx], zA=other.zA[idx],
-                                     zB=other.zB[idx])
+                if transplant:
+                    if oth_ch is not None:
+                        st = st._replace(
+                            x=oth_ch["x"][ci], yA=oth_ch["yA"][ci],
+                            yB=oth_ch["yB"][ci], zA=oth_ch["zA"][ci],
+                            zB=oth_ch["zB"][ci])
+                    else:
+                        st = st._replace(
+                            x=other.x[idx], yA=other.yA[idx],
+                            yB=other.yB[idx], zA=other.zA[idx],
+                            zB=other.zB[idx])
                 states.append(st)
             self._qp_states[ck] = states
         return self._qp_states[ck]
 
-    def _spread_devices_for(self, split_mode):
-        """Devices for round-robin chunk spreading, or None. Engages
-        when the engine holds a >1-device mesh (the MULTICHIP shape) or
-        when ``subproblem_spread_devices=<n>`` asks for n local devices
-        explicitly; split (df32) mode never spreads — its chunks FLOW
-        one factor sequentially (see pass 1) and a per-device factor
-        per chunk is exactly the HBM multiplication the flow avoids."""
-        if split_mode:
-            return None
-        opt = self.options.get("subproblem_spread_devices", "auto")
-        if opt in (0, "0", None, False):
-            return None
-        if self.mesh is not None:
-            from ..parallel.mesh import spread_devices
-            return spread_devices(self.mesh)
-        if opt == "auto":
-            # meshless engines stay single-device unless explicitly
-            # asked: every local process (tests run 8 virtual CPU
-            # devices) silently fanning out would multiply compile
-            # count and HBM residency without anyone opting in
-            return None
-        devs = jax.devices()[:int(opt)]
-        return devs if len(devs) > 1 else None
+    def _local_chunk(self, chunk):
+        """Per-device chunk rows for the sharded chunked loop:
+        ``subproblem_chunk`` bounds the per-device microbatch, and the
+        local chunk size is rounded so every chunk is a full local
+        slice of every shard (core/spbase pads S from the same shared
+        formula, so lc always divides the shard)."""
+        from ..parallel.mesh import local_chunk_layout
+        return local_chunk_layout(self._shard_ops.shard_size, chunk)[1]
 
-    def _spread_replicas(self, key, factors, data, devices):
-        """Per-device copies of the shared solve operands (factors +
-        shared A / P) for chunk spreading, cached per mode until
-        invalidate_factors. Replication is the price of the data
-        parallelism: each device holds the full shared matrix, exactly
-        like every MPI rank of the reference holds its scenarios'
-        models."""
-        from ..parallel.mesh import put_chunk
-        ck = ("spread", key)
-        ent = self._spread_cache.get(ck)
-        if ent is None or ent[0] is not factors:
-            reps = {dev: (put_chunk(factors, dev), put_chunk(data.A, dev),
-                          put_chunk(data.P_diag, dev))
-                    for dev in devices}
-            ent = (factors, reps)
-            self._spread_cache[ck] = ent
-        return ent[1]
+    def _sharded_chunk_slices(self, lc):
+        """(global_scenario_ids, rows) per sharded chunk — the gate /
+        hospital / trace bookkeeping map for the strided chunk layout
+        (chunk ci = local rows [ci*lc, (ci+1)*lc) of EVERY shard).
+        Cached beside the host chunk index (same invalidation)."""
+        ops = self._shard_ops
+        n_chunks, ce = ops.chunk_layout(lc)
+        if not hasattr(self, "_chunk_idx_cache"):
+            self._chunk_idx_cache = {}
+        key = ("sharded", lc, self.batch.S)
+        if key not in self._chunk_idx_cache:
+            self._chunk_idx_cache[key] = [
+                (ops.chunk_global_index(ci, lc), ce)
+                for ci in range(n_chunks)]
+        return self._chunk_idx_cache[key]
 
-    def _home_put(self, tree):
-        """Return a pytree committed to the engine's HOME placement —
-        replicated over the mesh when one exists (so spread-solve
-        outputs can mix with GSPMD-sharded reduction inputs), the
-        default device otherwise."""
-        if self.mesh is not None:
-            from ..parallel.mesh import replicated_sharding
-            if obs.enabled():
-                obs.counter_add("xfer.device_put_bytes",
-                                _obs_resource.put_nbytes(
-                                    tree, lambda a: replicated_sharding(
-                                        self.mesh, a.ndim)))
-            return jax.tree.map(
-                lambda a: jax.device_put(
-                    a, replicated_sharding(self.mesh, a.ndim)), tree)
-        if obs.enabled():
-            home = jax.devices()[0]
-            obs.counter_add("xfer.device_put_bytes",
-                            _obs_resource.put_nbytes(tree, lambda a: home))
-        return jax.device_put(tree, jax.devices()[0])
+    def _chunked_inputs(self, data, lc):
+        """Every per-scenario operand of one chunked sharded pass,
+        restaged as (n_chunks, lc*n_dev, ...) sharded arrays in ONE
+        jitted local reshape — no per-chunk device_put, no host
+        threads; ``chs[name][ci]`` is chunk ci's sharded slice."""
+        per_scen = {"l": data.l, "u": data.u, "lb": data.lb,
+                    "ub": data.ub, "c": self.c, "c0": self.c0,
+                    "P0": self.P_diag, "W": self.W, "xbar": self.xbar,
+                    "rho": self.rho, "fm": self._fixed_mask,
+                    "fv": self._fixed_vals}
+        if self._w_scale is not None:
+            per_scen["ws"] = self._w_scale
+        return self._shard_ops.to_chunks(per_scen, lc)
 
     def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed):
         """Host-looped scenario microbatching: S scenarios solved in
@@ -731,17 +748,23 @@ class PHBase(SPBase):
          - ASSEMBLE: every chunk's (q, bounds) is enqueued up front, so
            per-chunk host assembly cost hides behind device compute
            instead of sitting on the critical path before each solve;
-         - SOLVE: non-split chunks round-robin across devices when a
-           >1-device mesh is available (waves of ~ceil(chunks/n_dev)
-           concurrent solves, each driven by its own host thread with
-           explicit device_put placement); split (df32) chunks keep the
-           sequential factor flow and overlap assembly only. Warm-start
-           states are DONATED to the solver after the first pass (see
+         - SOLVE: on a >1-device mesh every chunk is SHARDED over the
+           "scen" axis (chunk ci = local rows [ci*lc, (ci+1)*lc) of
+           every device's shard, staged by one jitted local reshape —
+           parallel/mesh.ShardedScenarioOps): each microbatch solve is
+           ONE SPMD program with all devices solving lc scenarios and
+           the in-solve residual/convergence reductions riding psum —
+           no per-chunk device_put, no per-device host threads (the
+           round-robin spreading this replaces is documented as
+           superseded in doc/pipelining.md; anatomy in
+           doc/sharding.md). Split (df32) chunks keep the sequential
+           factor flow in both layouts. Warm-start states are DONATED
+           to the solver after the first pass (see
            qp_solver._qp_solve_jit_donated) so per-segment factor
            copies alias instead of duplicating;
          - GATE: the recovery/hospital decisions read ONE stacked
            residual matrix — a single D2H transfer per PH iteration
-           instead of one blocking sync per chunk.
+           instead of one blocking sync per chunk (or per device).
         Per-phase wall-clock and sync counts land in
         ``phase_timing()`` and, when telemetry is configured (obs),
         as Chrome-trace spans + counters (doc/observability.md)."""
@@ -753,10 +776,19 @@ class PHBase(SPBase):
                 "(every scenario must carry the same A and P; "
                 "per-scenario matrices need per-scenario factors and "
                 "gain nothing from chunking)")
-        slices = self._chunk_index(chunk)
+        ops = self._shard_ops
+        sharded = ops is not None
+        if sharded:
+            lc = self._local_chunk(chunk)
+            slices = self._sharded_chunk_slices(lc)
+            chs = self._chunked_inputs(data, lc)
+        else:
+            lc, chs = None, None
+            slices = self._chunk_index(chunk)
         self._drop_if_dirty(key)
         fresh_states = ("chunks", key) not in self._qp_states
-        states = self._ensure_chunk_states(key, factors, data, slices)
+        states = self._ensure_chunk_states(key, factors, data, slices,
+                                           chunks=chs, lc=lc)
         if fresh_states:
             # rebuilt chunk states share cold-state buffers — donation
             # must wait for the first completed pass to privatize them
@@ -780,14 +812,15 @@ class PHBase(SPBase):
         if donate:
             self._chunk_dirty.add(key)   # cleared after pass 3 stores
             obs.counter_add("qp.donated_passes")
-        devices = self._spread_devices_for(split_mode) if pipeline else None
         ent = self._phase_times.setdefault(
             key, {"acc": {"assemble": 0.0, "solve": 0.0, "gate": 0.0,
                           "reduce": 0.0},
-                  "calls": 0, "gate_syncs": 0, "devices": 1})
+                  "calls": 0, "gate_syncs": 0, "devices": 1,
+                  "mode": "host"})
         acc = ent["acc"]
         ent["calls"] += 1
-        ent["devices"] = len(devices) if devices else 1
+        ent["devices"] = ops.n_devices if sharded else 1
+        ent["mode"] = "sharded" if sharded else "host"
         gate_syncs = 0
         # one shared args dict per call (never mutated): lets trace
         # consumers split phase spans by solve mode, allocated only
@@ -807,10 +840,23 @@ class PHBase(SPBase):
             t_mark = now
 
         # record layout (indices 0-3 are the _hospitalize contract):
-        #  [st, x, yA, yB, d_loc, q_loc, dev, fac_loc, d_home, q_home]
-        # *_loc live wherever the solve ran (spread device or home);
-        # *_home are the home-placement twins pass 3 consumes.
+        #  [st, x, yA, yB, d_c, q_c, factors]
+        # sharded chunks are mesh-placed end to end (solve outputs ARE
+        # reduction inputs — no home/loc distinction survives the
+        # spread path's retirement).
         def _assemble(ci):
+            if sharded:
+                # local slices of the pre-chunked store — elementwise
+                # jit on sharded operands, zero host gathers
+                d_c = data._replace(l=chs["l"][ci], u=chs["u"][ci],
+                                    lb=chs["lb"][ci], ub=chs["ub"][ci])
+                ws = chs["ws"][ci] if "ws" in chs else None
+                q_c, bl_c, bu_c = _ph_assemble(
+                    d_c, chs["c"][ci], chs["W"][ci], chs["xbar"][ci],
+                    chs["rho"][ci], self.nonant_idx, chs["fm"][ci],
+                    chs["fv"][ci], ws, w_on=bool(w_on),
+                    prox_on=bool(prox_on))
+                return d_c._replace(lb=bl_c, ub=bu_c), q_c
             idx_c, _ = slices[ci]
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
                                 lb=data.lb[idx_c], ub=data.ub[idx_c])
@@ -836,100 +882,53 @@ class PHBase(SPBase):
         # computed strictly on accepted solutions.)
         solved_chunks = [None] * len(slices)
         prev_st = None
-        if devices and len(slices) > 1:
-            # multi-device chunk spreading: chunk ci runs WHOLE on
-            # devices[ci % n_dev]; each chunk's segmented solve is
-            # driven by its own host thread (the per-segment iteration
-            # readback blocks only that thread), so the sequential
-            # 8-chunk loop becomes ~ceil(8/n_dev) concurrent waves
-            from concurrent.futures import ThreadPoolExecutor
-            from ..parallel.mesh import put_chunk
-            reps = self._spread_replicas(key, factors, data, devices)
-
-            def _run(ci):
-                dev = devices[ci % len(devices)]
-                fac_d, A_d, P_d = reps[dev]
-                d0, q0 = inputs[ci]
-                if obs.enabled():
-                    # spread shipping: only leaves NOT already resident
-                    # on this chunk's device count (warm-start states
-                    # stay put after the first wave)
-                    obs.counter_add(
-                        "xfer.device_put_bytes",
-                        _obs_resource.put_nbytes(
-                            (d0.l, d0.u, d0.lb, d0.ub, q0, states[ci]),
-                            lambda a: dev))
-                d_d = QPData(P_d, A_d,
-                             put_chunk(d0.l, dev), put_chunk(d0.u, dev),
-                             put_chunk(d0.lb, dev), put_chunk(d0.ub, dev))
-                q_d = put_chunk(q0, dev)
-                st_in = put_chunk(states[ci], dev)
-                t_c = _time.perf_counter()
-                st, x, yA, yB = _solver_call(fac_d, d_d, q_d, st_in,
-                                             donate=donate, **kw)
-                if obs.enabled():
-                    # per-chunk span on a per-device lane: the spread
-                    # renders as parallel tracks in Perfetto
-                    obs.complete_span(
-                        "ph.solve.chunk", t_c, _time.perf_counter(),
-                        cat="ph", args={"chunk": ci, "device": str(dev),
-                                        "mode": sp_args["mode"]},
-                        lane=f"dev{ci % len(devices)}")
-                # outputs ship home (async D2D) for the reductions; the
-                # warm-start state stays resident on its device
-                x, yA, yB = self._home_put((x, yA, yB))
-                return [st, x, yA, yB, d_d, q_d, dev, fac_d, d0, q0]
-
-            with ThreadPoolExecutor(
-                    max_workers=min(len(devices), len(slices))) as ex:
-                for ci, rec in enumerate(ex.map(_run,
-                                                range(len(slices)))):
-                    solved_chunks[ci] = rec
-        else:
-            for ci in range(len(slices)):
-                if pipeline:
-                    d_c, q_c = inputs[ci]
-                else:
-                    # sequential opt-out: assembly stays interleaved on
-                    # the critical path, but its wall-clock books under
-                    # "assemble" (advancing t_mark keeps it out of
-                    # "solve") so the seq-vs-pipelined anatomy the
-                    # instrumentation exists for compares honestly
-                    t_a = _time.perf_counter()
-                    d_c, q_c = _assemble(ci)
-                    dt_a = _time.perf_counter() - t_a
-                    acc["assemble"] += dt_a
-                    t_mark += dt_a
-                st_in = states[ci]
-                t_c = _time.perf_counter()
-                if split_mode and prev_st is not None:
-                    # df32: chunks FLOW one (rho_scale, factor) pair
-                    # through the sequential loop (the in-jit adaptation
-                    # keeps its responsiveness, each chunk inheriting
-                    # the previous chunk's adapted stepsize) instead of
-                    # holding a private ~0.7 GB factor per chunk —
-                    # per-chunk copies would multiply HBM by chunk
-                    # count x modes at exactly the scale the split
-                    # representation exists for. rho is a stepsize:
-                    # iterates warm-start across scale changes.
-                    st_in = st_in._replace(L=prev_st.L,
-                                           rho_scale=prev_st.rho_scale)
-                st, x, yA, yB = _solver_call(factors, d_c, q_c, st_in,
-                                             donate=donate, **kw)
-                if obs.enabled():
-                    obs.complete_span(
-                        "ph.solve.chunk", t_c, _time.perf_counter(),
-                        cat="ph", args={"chunk": ci,
-                                        "mode": sp_args["mode"]})
-                prev_st = st
-                if split_mode:
-                    # record a STRIPPED state: keeping each chunk's L
-                    # alive in solved_chunks until pass 3 would pin
-                    # every refactorized ~0.7 GB copy simultaneously
-                    # (the unify below re-attaches the flowed factor)
-                    st = st._replace(L=jnp.zeros((), jnp.float32))
-                solved_chunks[ci] = [st, x, yA, yB, d_c, q_c, None,
-                                     factors, d_c, q_c]
+        for ci in range(len(slices)):
+            if pipeline:
+                d_c, q_c = inputs[ci]
+            else:
+                # sequential opt-out: assembly stays interleaved on
+                # the critical path, but its wall-clock books under
+                # "assemble" (advancing t_mark keeps it out of
+                # "solve") so the seq-vs-pipelined anatomy the
+                # instrumentation exists for compares honestly
+                t_a = _time.perf_counter()
+                d_c, q_c = _assemble(ci)
+                dt_a = _time.perf_counter() - t_a
+                acc["assemble"] += dt_a
+                t_mark += dt_a
+            st_in = states[ci]
+            t_c = _time.perf_counter()
+            if split_mode and prev_st is not None:
+                # df32: chunks FLOW one (rho_scale, factor) pair
+                # through the sequential loop (the in-jit adaptation
+                # keeps its responsiveness, each chunk inheriting
+                # the previous chunk's adapted stepsize) instead of
+                # holding a private ~0.7 GB factor per chunk —
+                # per-chunk copies would multiply HBM by chunk
+                # count x modes at exactly the scale the split
+                # representation exists for. rho is a stepsize:
+                # iterates warm-start across scale changes.
+                st_in = st_in._replace(L=prev_st.L,
+                                       rho_scale=prev_st.rho_scale)
+            # sharded: ONE SPMD chunk solve over all devices (lc
+            # scenarios each, psum-reduced termination tests inside
+            # the jit); host-chunked: the single-device program
+            st, x, yA, yB = _solver_call(factors, d_c, q_c, st_in,
+                                         donate=donate, **kw)
+            if obs.enabled():
+                obs.complete_span(
+                    "ph.solve.chunk", t_c, _time.perf_counter(),
+                    cat="ph", args={"chunk": ci,
+                                    "mode": sp_args["mode"],
+                                    "devices": ent["devices"]})
+            prev_st = st
+            if split_mode:
+                # record a STRIPPED state: keeping each chunk's L
+                # alive in solved_chunks until pass 3 would pin
+                # every refactorized ~0.7 GB copy simultaneously
+                # (the unify below re-attaches the flowed factor)
+                st = st._replace(L=jnp.zeros((), jnp.float32))
+            solved_chunks[ci] = [st, x, yA, yB, d_c, q_c, factors]
         _lap("solve")
         # pass 2 — bounded recovery: a chunk whose warm-started rho
         # trajectory went pathological (per-chunk shared rho adapts on
@@ -992,7 +991,7 @@ class PHBase(SPBase):
             # them would poison every future warm start
             if (m <= thr) or (ci in no_retry and not is_nan):
                 continue
-            fac_c = rec[7]
+            fac_c = rec[6]
             if is_nan:
                 # NaN blowup: the iterates themselves are poison — a
                 # rho reset would re-iterate NaNs; restart cold
@@ -1029,18 +1028,13 @@ class PHBase(SPBase):
                 st2 = st2._replace(L=jnp.zeros((), jnp.float32))
                 st_r = st_r._replace(L=jnp.zeros((), jnp.float32))
             if np.isfinite(m2) and (is_nan or m2 < m):
-                if rec[6] is not None:
-                    x2, yA2, yB2 = self._home_put((x2, yA2, yB2))
                 rec[:4] = [st2, x2, yA2, yB2]
                 pri_host[ci] = pri2
             elif is_nan:
                 # both attempts NaN: keep the CLEAN cold state so the
                 # next iteration starts from finite values (zero duals
                 # still certify a valid, if loose, bound)
-                xr, yAr, yBr = st_r.x, st_r.yA, st_r.yB
-                if rec[6] is not None:
-                    xr, yAr, yBr = self._home_put((xr, yAr, yBr))
-                rec[:4] = [st_r, xr, yAr, yBr]
+                rec[:4] = [st_r, st_r.x, st_r.yA, st_r.yB]
                 pri_host[ci] = np.inf   # cold-state residuals
             if not (m2 <= thr):
                 no_retry.add(ci)
@@ -1079,8 +1073,10 @@ class PHBase(SPBase):
             for ci, (idx_c, real) in enumerate(slices):
                 pr = pri_host[ci][:real]
                 for r in np.flatnonzero(~(pr <= thr)):
-                    standing.append((int(np.asarray(idx_c)[r]),
-                                     float(pr[r])))
+                    g = int(np.asarray(idx_c)[r])
+                    if g >= self._S_orig:
+                        continue   # zero-probability mesh pad rows
+                    standing.append((g, float(pr[r])))
             if standing:
                 g_w, pr_w = max(standing, key=lambda t: t[1])
                 when = (f"re-admission in {readmit - calls % readmit} "
@@ -1101,12 +1097,18 @@ class PHBase(SPBase):
                                  "dual")}
         for ci, (idx_c, real) in enumerate(slices):
             st, x, yA, yB = solved_chunks[ci][:4]
-            d_h, q_h = solved_chunks[ci][8], solved_chunks[ci][9]
+            d_h, q_h = solved_chunks[ci][4], solved_chunks[ci][5]
             states[ci] = st
+            if sharded:
+                c_c, c0_c, P0_c, W_c = (chs["c"][ci], chs["c0"][ci],
+                                        chs["P0"][ci], chs["W"][ci])
+            else:
+                c_c, c0_c, P0_c, W_c = (self.c[idx_c], self.c0[idx_c],
+                                        self.P_diag[idx_c],
+                                        self.W[idx_c])
             xn, base, solved, dual = _ph_chunk_objs(
-                x, yA, yB, d_h, q_h, self.c[idx_c], self.c0[idx_c],
-                self.P_diag[idx_c], self.nonant_idx, self.W[idx_c],
-                w_on=bool(w_on))
+                x, yA, yB, d_h, q_h, c_c, c0_c, P0_c, self.nonant_idx,
+                W_c, w_on=bool(w_on))
             for k, v in (("x", x[:real]), ("yA", yA[:real]),
                          ("yB", yB[:real]), ("xn", xn[:real]),
                          ("base", base[:real]), ("solved", solved[:real]),
@@ -1126,21 +1128,34 @@ class PHBase(SPBase):
         # and this pass's donation window is closed
         self._chunk_dirty.discard(key)
         self._chunk_donatable.add(key)
-        cat = {k: jnp.concatenate(v) for k, v in parts.items()}
+        # reassembly: sharded chunks concatenate LOCALLY per device
+        # (each device's chunk rows are exactly its contiguous shard —
+        # one jitted shard_map, natural global order, no collectives);
+        # host chunks concatenate plainly
+        cat_fn = ops.from_chunks if sharded else jnp.concatenate
+        cat = {k: cat_fn(v) for k, v in parts.items()}
         # lazily concatenated read-only view for the state consumers
         # (assert_feasible_iter0, incumbent feasibility, bench prints);
         # per-chunk states stay authoritative for warm starts
         self._qp_states[key] = _ChunkStateView(
             states, [real for _, real in slices],
             precomputed={"x": cat["x"], "yA": cat["yA"],
-                         "yB": cat["yB"]})
+                         "yB": cat["yB"]},
+            concat_fn=ops.from_chunks if sharded else None)
         self.x, self.yA, self.yB = cat["x"], cat["yA"], cat["yB"]
         if update:
             wmask = None if self._w_scale is None else self._w_scale > 0
-            xbar_new, xsqbar_new, W_new, conv = _ph_combine(
-                cat["xn"], self.prob, self.xbar_weights,
-                tuple(self.memberships), self.W, self.rho, wmask,
-                slot_slices=self.slot_bounds)
+            if sharded:
+                # Compute_Xbar / Update_W / convergence as segment-sum
+                # + psum over the named axis (doc/sharding.md)
+                xbar_new, xsqbar_new, W_new, conv = ops.combine(
+                    cat["xn"], self.prob, self.xbar_weights, self.W,
+                    self.rho, wmask)
+            else:
+                xbar_new, xsqbar_new, W_new, conv = _ph_combine(
+                    cat["xn"], self.prob, self.xbar_weights,
+                    tuple(self.memberships), self.W, self.rho, wmask,
+                    slot_slices=self.slot_bounds)
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
             self.conv = float(conv)
@@ -1183,6 +1198,9 @@ class PHBase(SPBase):
             "occupancy": (per_call["solve"] / total) if total > 0 else 0.0,
             "gate_d2h_syncs_per_call": ent["gate_syncs"] / n,
             "devices": ent["devices"],
+            # "sharded": scenario-axis SPMD over the mesh;
+            # "host": single-device dispatch (doc/sharding.md)
+            "mode": ent.get("mode", "host"),
         }
 
     def _phase_totals(self):
@@ -1205,8 +1223,10 @@ class PHBase(SPBase):
         st = self._qp_states.get(key)
         if st is None:
             return None
-        pri = np.asarray(st.pri_rel)
-        dua = np.asarray(st.dua_rel)
+        # mesh pads (zero-probability copies) are excluded: a pad row's
+        # residual is redundant with its source scenario's
+        pri = np.asarray(st.pri_rel)[:self._S_orig]
+        dua = np.asarray(st.dua_rel)[:self._S_orig]
         return {"pri_rel_max": float(pri.max()),
                 "pri_rel_mean": float(pri.mean()),
                 "dua_rel_max": float(dua.max()),
@@ -1218,7 +1238,13 @@ class PHBase(SPBase):
     _ITER_DELTA_COUNTERS = ("ph.gate_syncs", "ph.chunk_retries",
                             "ph.hospital_treated", "ph.standing_rows",
                             "ph.blacklist_readmitted", "qp.donated_passes",
-                            "qp.solve_segments", "jax.compiles")
+                            "qp.solve_segments", "jax.compiles",
+                            # sharded engines: the steady-state contract
+                            # is collective bytes > 0 and device_put
+                            # bytes == 0 (so device_put only appears in
+                            # a record when something went wrong)
+                            "xfer.collective_bytes",
+                            "xfer.device_put_bytes")
 
     def iteration_record(self, it, seconds, phase_before, counters_before):
         """The structured per-iteration convergence record (the
@@ -1230,6 +1256,13 @@ class PHBase(SPBase):
         fin = obs.finite_or_none
         rec = {"iter": it, "conv": fin(self.conv), "seconds": seconds,
                "best_outer": fin(self.best_bound)}
+        if self._shard_ops is not None:
+            # the sharding anatomy analyze's sharding section renders
+            # (collective bytes arrive via counter_deltas below)
+            rec["sharding"] = {
+                "mode": "sharded",
+                "n_devices": self._shard_ops.n_devices,
+                "shard_scenarios": self._shard_ops.shard_size}
         if self.spcomm is not None:
             outer = fin(getattr(self.spcomm, "BestOuterBound", None))
             inner = fin(getattr(self.spcomm, "BestInnerBound", None))
@@ -1285,8 +1318,10 @@ class PHBase(SPBase):
                 g = int(np.asarray(idx_c)[r])
                 # keyed by GLOBAL scenario id: chunk-local coordinates
                 # would re-target other scenarios if the chunk size
-                # ever changes mid-run
-                if g not in failed:
+                # ever changes mid-run. Zero-probability mesh pad rows
+                # never earn a rescue solve — they are copies of a real
+                # scenario and carry no objective weight.
+                if g not in failed and g < self._S_orig:
                     picks.append((ci, int(r), g, float(pr[r])))
         if not picks:
             return 0
@@ -1356,12 +1391,6 @@ class PHBase(SPBase):
             # never re-admitted).
             res_rows = (st_h.pri_res[j], st_h.dua_res[j],
                         st_h.pri_rel[j], st_h.dua_rel[j])
-            dev = rec[6] if len(rec) > 6 else None
-            if dev is not None:
-                # spread mode keeps the warm-start state resident on
-                # its round-robin device; the hospital solved at home
-                # placement, so its rows ship over before the scatter
-                res_rows = jax.device_put(res_rows, dev)
             rec[0] = st._replace(
                 pri_res=st.pri_res.at[r].set(res_rows[0]),
                 dua_res=st.dua_res.at[r].set(res_rows[1]),
@@ -1423,7 +1452,14 @@ class PHBase(SPBase):
         t0 = _time.perf_counter()
         obs.counter_add("ph.solve_loop_calls")
         chunk = int(self.options.get("subproblem_chunk", 0))
-        if chunk and chunk < self.batch.S:
+        # sharded engines read ``subproblem_chunk`` as the PER-DEVICE
+        # microbatch bound (the device-call stability limit is per
+        # device): a shard that already fits one chunk runs the fused
+        # SPMD step; larger shards run the sharded chunked loop
+        sh = self._shard_ops
+        chunked = bool(chunk) and (chunk < sh.shard_size if sh is not None
+                                   else chunk < self.batch.S)
+        if chunked:
             out = self._solve_loop_chunked(chunk, w_on, prox_on, update,
                                            fixed)
             if self._timing:
@@ -1443,8 +1479,11 @@ class PHBase(SPBase):
         ent = self._phase_times.setdefault(
             skey, {"acc": {"assemble": 0.0, "solve": 0.0, "gate": 0.0,
                            "reduce": 0.0},
-                   "calls": 0, "gate_syncs": 0, "devices": 1})
+                   "calls": 0, "gate_syncs": 0, "devices": 1,
+                   "mode": "host"})
         ent["calls"] += 1
+        ent["devices"] = sh.n_devices if sh is not None else 1
+        ent["mode"] = "sharded" if sh is not None else "host"
         acc = ent["acc"]
         sp_args = {"mode": _mode_str(skey)} if obs.enabled() else None
         t_mark = _time.perf_counter()
@@ -1456,6 +1495,8 @@ class PHBase(SPBase):
             obs.complete_span(_PHASE_SPAN[phase], t_mark, now, cat="ph",
                               args=sp_args)
             t_mark = now
+
+        combine_fn = sh.combine if sh is not None else None
 
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
          base_obj, solved_obj, dual_obj) = _ph_step(
@@ -1474,7 +1515,8 @@ class PHBase(SPBase):
             stall_rel=self.sub_stall_rel, segment=self.sub_segment,
             polish_hot=self.sub_polish_hot,
             segment_lo=self.sub_segment_lo,
-            ir_sweeps=self.sub_ir_sweeps, lap=_lap)
+            ir_sweeps=self.sub_ir_sweeps, lap=_lap,
+            combine_fn=combine_fn)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB
         if update:
@@ -1521,8 +1563,10 @@ class PHBase(SPBase):
             tol = float(self.options.get("iter0_feas_tol",
                                          max(1e-3, 100 * self.sub_eps)))
         st = self._qp_states[False]
-        ok = (np.asarray(st.pri_res) <= tol) \
-            | (np.asarray(st.pri_rel) <= tol)
+        # mesh pad rows are trimmed: they duplicate a real scenario and
+        # must neither mask nor fabricate an infeasibility
+        ok = (np.asarray(st.pri_res)[:self._S_orig] <= tol) \
+            | (np.asarray(st.pri_rel)[:self._S_orig] <= tol)
         return ok, tol
 
     def assert_feasible_iter0(self, tol=None):

@@ -157,9 +157,26 @@ class SPBase:
         the batch is zero-probability-padded to the mesh size and all
         jitted engine steps compile to SPMD programs with XLA-chosen
         collectives for the nonant reductions."""
+        self._S_orig = batch.S
         if mesh is not None:
-            from ..parallel.mesh import pad_batch_for_mesh
-            batch, self._S_orig = pad_batch_for_mesh(batch, mesh.devices.size)
+            from ..parallel.mesh import local_chunk_layout, \
+                pad_batch_for_mesh
+            n_dev = int(mesh.devices.size)
+            mult = n_dev
+            chunk = int((options or {}).get("subproblem_chunk", 0) or 0)
+            if n_dev > 1 and chunk:
+                # sharded chunked mode (core/ph._solve_loop_chunked):
+                # ``subproblem_chunk`` bounds the PER-DEVICE microbatch,
+                # and each chunk is a local slice of every device's
+                # shard — so the shard must divide evenly into local
+                # chunks. Round S up so it does (shared formula with
+                # the runtime chunk staging — mesh.local_chunk_layout
+                # keeps the pad below one chunk-row per device).
+                L0 = -(-batch.S // n_dev)
+                if chunk < L0:
+                    n_chunks, lc = local_chunk_layout(L0, chunk)
+                    mult = n_dev * n_chunks * lc
+            batch, self._S_orig = pad_batch_for_mesh(batch, mult)
         self.mesh = mesh
         self.batch = batch
         self.options = dict(options or {})
@@ -296,11 +313,33 @@ class SPBase:
         # is unhashable before Python 3.12; see compute_xbar)
         self.slot_bounds = tuple((sl.start, sl.stop)
                                  for sl in b.stage_slot_slices)
+        # >1-device meshes: the explicit-collective scenario-axis ops
+        # (segment-sum over tree-node index + psum per stage, sharded
+        # chunk staging — parallel/mesh.ShardedScenarioOps). Single
+        # device (or no mesh): None, and reductions keep the dense
+        # membership-matmul spelling.
+        self._shard_ops = None
+        if mesh is not None and int(mesh.devices.size) > 1:
+            from ..parallel.mesh import ShardedScenarioOps
+            self._shard_ops = ShardedScenarioOps(
+                mesh, b.tree, self.slot_bounds, b.S)
 
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel.mesh import scenario_sharding
-            shard = lambda a: jax.device_put(a, scenario_sharding(mesh, a.ndim))
+
+            def shard(a):
+                if obs.enabled():
+                    # the ONE deliberate device_put of a sharded run:
+                    # the initial shard placement of the batch tensors.
+                    # Steady-state iterations must add NOTHING to this
+                    # counter (doc/sharding.md placement contract)
+                    from ..obs.resource import put_nbytes
+                    obs.counter_add(
+                        "xfer.device_put_bytes",
+                        put_nbytes(a, lambda leaf: scenario_sharding(
+                            mesh, leaf.ndim)))
+                return jax.device_put(a, scenario_sharding(mesh, a.ndim))
             # replicate per LEAF: a packed SplitMatrix mixes ranks
             # (dense (m, n) + index vectors), so one container-rank
             # spec would reject the rank-1 leaves
@@ -341,7 +380,11 @@ class SPBase:
         return self.prob if self.vprob is None else self.vprob
 
     def compute_xbar(self, xn):
-        """See the module-level compute_xbar (single implementation)."""
+        """See the module-level compute_xbar (single implementation of
+        the math); sharded engines run the collective segment-sum
+        spelling instead (one psum per stage — parallel/mesh)."""
+        if self._shard_ops is not None:
+            return self._shard_ops.xbar(self.xbar_weights, xn)
         return compute_xbar(self.memberships, self.slot_slices,
                             self.xbar_weights, xn)
 

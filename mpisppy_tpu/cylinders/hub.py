@@ -44,8 +44,16 @@ class Hub(SPCommunicator):
         self.bound_events = []
         self.clock_anchor = {"wall_time_unix": time.time(),
                              "perf_counter": time.perf_counter()}
+        sh = getattr(spbase_object, "_shard_ops", None)
         obs.event("hub.start", {"hub": type(self).__name__,
                                 "spokes": len(self.spokes),
+                                # engine sharding anatomy (analyze's
+                                # sharding section reads this + the
+                                # ph.iteration records)
+                                "sharding": None if sh is None else
+                                {"mode": "sharded",
+                                 "n_devices": sh.n_devices,
+                                 "shard_scenarios": sh.shard_size},
                                 **self.clock_anchor})
         self._trivial_seed = None       # set when the hub seeds "T"
         self._print_rows = 0
@@ -387,10 +395,15 @@ class PHHub(Hub):
     def _hub_arrays(self):
         """(W_flat, X_flat) the spokes should see — the ONE overridable
         source (APHShardHub substitutes Synchronizer-gathered full
-        arrays; the push layout below stays shared)."""
-        return (np.asarray(self.opt.W, dtype=np.float64).reshape(-1),
+        arrays; the push layout below stays shared). A SHARDED hub
+        engine pads its scenario axis to the mesh (doc/sharding.md);
+        the cylinder wire format carries the REAL scenarios only —
+        spokes run unpadded engines and size their windows from the
+        true S."""
+        S = getattr(self.opt, "_S_orig", None)
+        return (np.asarray(self.opt.W, dtype=np.float64)[:S].reshape(-1),
                 np.asarray(self.opt._hub_nonants(),
-                           np.float64).reshape(-1))
+                           np.float64)[:S].reshape(-1))
 
     def send_ws(self, X=None, W=None):
         if W is None:
@@ -439,7 +452,9 @@ class CrossScenarioHub(PHHub):
                                   if getattr(sp, "is_cut_spoke", False)}
 
     def receive_bounds(self):
-        S, K = self.opt.batch.S, self.opt.batch.K
+        # wire format carries REAL scenarios (see _hub_arrays)
+        S, K = getattr(self.opt, "_S_orig", self.opt.batch.S), \
+            self.opt.batch.K
         for i in self.cut_spoke_indices:
             sp = self.spokes[i]
             values, wid = sp.my_window.read()
@@ -500,7 +515,9 @@ class LShapedHub(Hub):
 
     def sync(self, send_nonants=True):
         if send_nonants:
-            X = np.asarray(self.opt._hub_nonants(), np.float64).reshape(-1)
+            X = np.asarray(self.opt._hub_nonants(),
+                           np.float64)[:getattr(self.opt, "_S_orig",
+                                                None)].reshape(-1)
             for i in self.nonant_spoke_indices:
                 self.spokes[i].hub_window.put(X)
         self.receive_bounds()

@@ -19,36 +19,76 @@ import numpy as np
 from .extension import Extension
 
 
+def _real_S(opt):
+    """Checkpoints carry REAL scenarios only: a sharded engine pads its
+    batch with zero-probability copies (doc/sharding.md), and a file
+    written with pad rows would refuse to load into an unsharded run
+    of the same model (and vice versa)."""
+    return getattr(opt, "_S_orig", opt.batch.S)
+
+
+def _placer(opt):
+    """Engine-matched device placement for a full (S, K) host block: a
+    host-placed W/x̄ on a mesh engine would recompile every jitted step
+    for the new input sharding."""
+    t = opt.dtype
+    if opt.mesh is not None:
+        import jax
+        from ..parallel.mesh import scenario_sharding
+
+        def place(a):
+            return jax.device_put(jnp.asarray(a, t),
+                                  scenario_sharding(opt.mesh, 2))
+        return place
+    return lambda a: jnp.asarray(a, t)
+
+
 def save_state(opt, path):
     """Checkpoint the PH algorithm state to ``path`` (npz)."""
-    np.savez(path, W=np.asarray(opt.W), xbar=np.asarray(opt.xbar),
-             xsqbar=np.asarray(opt.xsqbar), rho=np.asarray(opt.rho),
-             iter=np.asarray(opt._iter))
+    S = _real_S(opt)
+    np.savez(path, W=np.asarray(opt.W)[:S], xbar=np.asarray(opt.xbar)[:S],
+             xsqbar=np.asarray(opt.xsqbar)[:S],
+             rho=np.asarray(opt.rho)[:S], iter=np.asarray(opt._iter))
 
 
 def load_state(opt, path):
-    """Restore a checkpoint saved by ``save_state`` (shape-checked)."""
+    """Restore a checkpoint saved by ``save_state`` (shape-checked
+    against the REAL scenario count; mesh pads are re-filled by
+    replicating the last real row — pads ARE copies of the last
+    scenario, so its x̄/ρ rows are the consistent fill and pad W
+    carries no objective weight)."""
     d = np.load(path)
-    S, K = opt.batch.S, opt.batch.K
+    S_real, K = _real_S(opt), opt.batch.K
+    S = opt.batch.S
     for key in ("W", "xbar", "xsqbar", "rho"):
-        if d[key].shape != (S, K):
-            raise ValueError(f"{key} shape {d[key].shape} != ({S}, {K})")
-    t = opt.dtype
-    opt.W = jnp.asarray(d["W"], t)
-    opt.xbar = jnp.asarray(d["xbar"], t)
-    opt.xsqbar = jnp.asarray(d["xsqbar"], t)
+        if d[key].shape != (S_real, K):
+            raise ValueError(f"{key} shape {d[key].shape} != "
+                             f"({S_real}, {K})")
+
+    def pad(a):
+        if S == S_real:
+            return a
+        return np.concatenate([a, np.repeat(a[-1:], S - S_real, axis=0)])
+
+    place = _placer(opt)
+    opt.W = place(pad(d["W"]))
+    opt.xbar = place(pad(d["xbar"]))
+    opt.xsqbar = place(pad(d["xsqbar"]))
     old_rho = np.asarray(opt.rho)
-    opt.rho = jnp.asarray(d["rho"], t)
+    new_rho = pad(d["rho"])
+    opt.rho = place(new_rho)
     opt._iter = int(d["iter"])
-    if not np.allclose(old_rho, d["rho"]):
+    if not np.allclose(old_rho, new_rho):
         opt.invalidate_factors()
 
 
 def _write_scen_csv(opt, path, arr):
-    """(scenario, slot, value) rows of an (S, K) block."""
+    """(scenario, slot, value) rows of an (S, K) block — REAL scenarios
+    only (mesh pad rows carry generated ``_pad*`` names an unsharded
+    reader of the same model could never resolve)."""
     with open(path, "w") as f:
         f.write("scenario,slot,value\n")
-        for s, name in enumerate(opt.batch.tree.scen_names):
+        for s, name in enumerate(opt.batch.tree.scen_names[:_real_S(opt)]):
             for k in range(opt.batch.K):
                 f.write(f"{name},{k},{arr[s, k]:.17g}\n")
 
@@ -75,9 +115,19 @@ def write_w_csv(opt, path):
     _write_scen_csv(opt, path, np.asarray(opt.W))
 
 
+def _read_and_install(opt, path, cur):
+    """Shared body of the CSV readers: fill real rows from the file,
+    re-fill mesh pad rows from the last real row (same semantics as
+    ``load_state``), and install with the engine's placement."""
+    a = _read_scen_csv(opt, path, np.asarray(cur).copy())
+    S_real = _real_S(opt)
+    if opt.batch.S != S_real:
+        a[S_real:] = a[S_real - 1]
+    return _placer(opt)(a)
+
+
 def read_w_csv(opt, path):
-    opt.W = jnp.asarray(_read_scen_csv(opt, path, np.asarray(opt.W).copy()),
-                        opt.dtype)
+    opt.W = _read_and_install(opt, path, opt.W)
 
 
 def write_xbar_csv(opt, path):
@@ -89,8 +139,7 @@ def write_xbar_csv(opt, path):
 
 
 def read_xbar_csv(opt, path):
-    opt.xbar = jnp.asarray(
-        _read_scen_csv(opt, path, np.asarray(opt.xbar).copy()), opt.dtype)
+    opt.xbar = _read_and_install(opt, path, opt.xbar)
 
 
 class WXBarWriter(Extension):

@@ -153,7 +153,7 @@ def complete_span(name, t0, t1, cat="host", args=None, lane=None):
 
 def counters_snapshot() -> dict:
     """Copy of the counter map ({} when telemetry is disabled). Taken
-    under the registry lock — spoke/chunk-spread threads may be
+    under the registry lock — spoke cylinder threads may be
     inserting new keys concurrently."""
     r = _REC
     return r.metrics.counters_snapshot() if r is not None else {}

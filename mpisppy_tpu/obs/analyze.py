@@ -215,6 +215,43 @@ def compile_summary(run: Run) -> dict:
             "late_retrace_iters": late}
 
 
+def sharding_summary(run: Run) -> dict | None:
+    """The scenario-axis sharding anatomy of a run (ISSUE 6): device
+    count and shard size from the ``ph.iteration`` records' sharding
+    block (falling back to ``hub.start``), plus the collective-traffic
+    estimate from the ``xfer.collective_bytes`` counter. None when the
+    run never sharded."""
+    info = None
+    iters = 0
+    dp_iter = 0
+    for e in iteration_rows(run):
+        sh = e.get("sharding")
+        if isinstance(sh, dict):
+            info = sh
+            iters += 1
+            dp_iter += (e.get("counter_deltas") or {}).get(
+                "xfer.device_put_bytes", 0)
+    if info is None:
+        for e in run.of("hub.start"):
+            sh = e.get("sharding")
+            if isinstance(sh, dict):
+                info = sh
+    if info is None:
+        return None
+    c = run.counters()
+    out = dict(info)
+    out["collective_bytes_total"] = c.get("xfer.collective_bytes", 0)
+    if iters:
+        out["collective_bytes_per_iter"] = \
+            out["collective_bytes_total"] / iters
+    # total includes the legitimate one-time initial shard placement;
+    # the ITERATION sum is the steady-state placement contract (must
+    # be zero — doc/sharding.md)
+    out["device_put_bytes_total"] = c.get("xfer.device_put_bytes", 0)
+    out["device_put_bytes_iterations"] = dp_iter
+    return out
+
+
 def fault_summary(run: Run) -> dict:
     """The supervision/ingest-validation story of a run (counters from
     the hub role, per-spoke detail from the events): downs, respawns,
@@ -457,6 +494,24 @@ def render_report(run: Run) -> str:
             for k, v in sorted(xfer.items())))
     L.append("")
 
+    sh = sharding_summary(run)
+    if sh is not None:
+        L.append("== sharding ==")
+        L.append(f"mode {sh.get('mode')}  devices {sh.get('n_devices')}  "
+                 f"shard {sh.get('shard_scenarios')} scenario(s)/device")
+        per = sh.get("collective_bytes_per_iter")
+        L.append(f"collective bytes: {_fmt_b(sh['collective_bytes_total'])}"
+                 + (f" total, {_fmt_b(per)}/iter" if per else " total")
+                 + " (psum operand estimate)")
+        dp = sh.get("device_put_bytes_iterations", 0)
+        L.append(f"device_put bytes: "
+                 f"{_fmt_b(sh.get('device_put_bytes_total', 0))} total "
+                 f"(setup placement), {_fmt_b(dp)} across iterations"
+                 + ("" if dp == 0 else
+                    "  [NONZERO — steady-state sharded iterations "
+                    "should not device_put]"))
+        L.append("")
+
     L.append("== counters ==")
     for k in sorted(c):
         if k.split(".")[0] in ("ph", "qp", "hub", "spoke"):
@@ -523,6 +578,17 @@ def comparison_metrics(run: Run) -> dict:
             c.get("ph.gate_syncs", 0) / calls
         out[("xla_compiles_per_solve_call", "count")] = \
             c.get("jax.compiles", 0) / calls
+        # sharded runs (ISSUE 6): collective traffic per solve call and
+        # steady-state device_put leakage — a sharded-vs-sharded
+        # compare flags a collective-volume or placement regression;
+        # keys absent on unsharded runs are skipped by compare()
+        if "xfer.collective_bytes" in c:
+            out[("collective_kbytes_per_solve_call", "count")] = \
+                c["xfer.collective_bytes"] / 1024.0 / calls
+            sh = sharding_summary(run)
+            if sh is not None:
+                out[("device_put_kbytes_across_iterations", "count")] = \
+                    sh.get("device_put_bytes_iterations", 0) / 1024.0
     h = run.histograms().get("ph.iteration_seconds", {})
     if h.get("p99") is not None:
         out[("ph_iteration_seconds_p99", "time")] = h["p99"]
@@ -625,6 +691,7 @@ def main(argv=None) -> int:
                 "memory": memory_watermarks(run),
                 "compile": {k: v for k, v in compile_summary(run).items()
                             if k != "entries"},
+                "sharding": sharding_summary(run),
                 "faults": fault_summary(run),
                 "invariants": [
                     {"name": n, "ok": ok, "detail": d, "severity": sv}

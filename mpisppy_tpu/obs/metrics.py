@@ -102,8 +102,8 @@ class Histogram:
 
 class MetricsRegistry:
     """Counters, gauges, and histograms under one lock (hot-loop
-    counter bumps can arrive from the chunk-spreading host threads and
-    the spoke cylinder threads concurrently)."""
+    counter bumps can arrive from the spoke cylinder threads and the
+    hub's iteration concurrently)."""
 
     def __init__(self):
         self._lock = threading.Lock()

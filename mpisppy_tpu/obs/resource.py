@@ -26,7 +26,7 @@ Three surfaces:
    returns None) — sampled once per PH iteration and at bench phase
    boundaries.
  - **Transfer byte helpers** (:func:`tree_nbytes`): the instrumented
-   sites (core/ph.py gate reads and spread/home ``device_put``s,
+   sites (core/ph.py gate reads,
    core/spbase.py batch shipping, ops/qp_solver.py host rho
    refactors) guard with ``obs.enabled()`` and add to
    ``xfer.h2d_bytes`` / ``xfer.d2h_bytes`` / ``xfer.device_put_bytes``

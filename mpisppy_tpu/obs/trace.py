@@ -4,8 +4,8 @@ Spans are host wall-clock intervals (``time.perf_counter`` pairs)
 buffered as Chrome trace-event "X" (complete) records and written as
 one ``trace.json`` loadable in Perfetto / chrome://tracing. The PH
 pipeline phases (assemble/solve/gate/reduce), per-chunk solves and
-per-device lanes all land here; lanes map to Chrome ``tid`` so a
-multi-device chunk spread renders as parallel tracks.
+per-chunk lanes all land here; lanes map to Chrome ``tid`` so
+concurrent work renders as parallel tracks.
 
 Two recording styles:
  - ``complete(name, t0, t1)`` — the hot-loop style: the caller already

@@ -1382,10 +1382,10 @@ def stacked_residuals(states, field="pri_rel"):
     residuals each iteration; transferring them one chunk at a time
     costs ceil(S/chunk) blocking D2H syncs — stacking on device first
     means the caller pays exactly ONE host transfer
-    (``np.asarray(stacked_residuals(...))``) per PH iteration. Chunks
-    solved on different devices (multi-device spreading) are colocated
-    onto the first chunk's device before the stack; those copies ride
-    the device interconnect asynchronously."""
+    (``np.asarray(stacked_residuals(...))``) per PH iteration. Sharded
+    chunk states all carry the same mesh placement (colocate passes
+    through); the stack compiles to a sharded (n_chunks, chunk) array
+    and the host read gathers it in one transfer."""
     from ..parallel.mesh import colocate
     return jnp.stack(colocate([getattr(s, field) for s in states]))
 

@@ -1,1 +1,2 @@
-from .mesh import make_mesh, shard_arrays, scenario_sharding  # noqa: F401
+from .mesh import (make_mesh, shard_arrays, scenario_sharding,  # noqa: F401
+                   pad_batch_for_mesh, ShardedScenarioOps)

@@ -116,6 +116,17 @@ class RunConfig:
     # heartbeat_timeout, max_respawns, respawn_backoff(+_cap),
     # max_rejections, poll_interval, crossed_bound_tol
     supervisor: dict = field(default_factory=dict)
+    # ---- scenario-axis sharding (doc/sharding.md) ----
+    # mesh over the local (or, with ``coordinator``, global) device
+    # set for the hub engine: None = single-device; 0 = all devices;
+    # n > 0 = the first n. The engine shards every per-scenario tensor
+    # over the mesh's "scen" axis and runs the PH step SPMD.
+    mesh_devices: int | None = None
+    # multi-process JAX over DCN (jax.distributed.initialize), so the
+    # supervised process wheel spans hosts: {"address": "host:port",
+    # "num_processes": N, "process_id": I, "local_device_ids": [...]}
+    # — every field but ``address`` optional (TPU pods self-discover).
+    coordinator: dict | None = None
 
     def validate(self):
         if self.model not in KNOWN_MODELS:
@@ -145,6 +156,23 @@ class RunConfig:
         if bad:
             raise ValueError(f"unknown supervisor options {sorted(bad)}; "
                              f"known: {sorted(KNOWN_OPTIONS)}")
+        if self.mesh_devices is not None and self.mesh_devices < 0:
+            raise ValueError("mesh_devices must be None (no mesh), 0 "
+                             "(all devices), or a positive count")
+        if self.coordinator is not None:
+            known = {"address", "num_processes", "process_id",
+                     "local_device_ids"}
+            bad = set(self.coordinator) - known
+            if bad:
+                raise ValueError(f"unknown coordinator keys {sorted(bad)};"
+                                 f" known: {sorted(known)}")
+            if not self.coordinator.get("address"):
+                raise ValueError("coordinator needs an 'address' "
+                                 "(\"host:port\" of process 0)")
+            for k in ("num_processes", "process_id"):
+                v = self.coordinator.get(k)
+                if v is not None and int(v) < 0:
+                    raise ValueError(f"coordinator.{k} must be >= 0")
         self.algo.validate()
         for sp in self.spokes:
             sp.validate()

@@ -110,9 +110,15 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
     import jax
 
     jax.config.update("jax_platforms", platform)
-    from .runtime import setup_jax_runtime
+    from .runtime import maybe_init_distributed, setup_jax_runtime
 
     setup_jax_runtime(f32)
+    # a spoke pinned to its own accelerator slice on another host may
+    # carry its own coordinator spec (options["coordinator"]) and join
+    # a multi-process JAX cluster of its own; the HUB's coordinator
+    # (cfg.coordinator) is deliberately NOT inherited here — spoke
+    # processes default to isolated single-process runtimes
+    maybe_init_distributed(opts.get("coordinator"))
 
     # telemetry capture for THIS cylinder process: role-suffixed
     # artifacts (events-<role>.jsonl / trace-<role>.json) in the run
@@ -291,6 +297,15 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
     timeouts default from the config (``cfg.join_timeout`` /
     ``cfg.spoke_ready_timeout``); explicit arguments win."""
     cfg.validate()
+    # multi-host wheels: bring up multi-process JAX (DCN) before the
+    # hub engine touches devices, so a ``mesh_devices`` hub shards over
+    # the GLOBAL device set while spokes keep their per-process
+    # runtimes (doc/sharding.md) — the PR 5 supervision layer
+    # (heartbeats, respawn on fresh windows, quarantine) is exactly the
+    # fault model a pod needs
+    from .runtime import maybe_init_distributed
+
+    maybe_init_distributed(cfg.coordinator)
     join_timeout = cfg.join_timeout if join_timeout is None \
         else join_timeout
     spoke_ready_timeout = cfg.spoke_ready_timeout \
@@ -307,7 +322,10 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
 
     hub_d = hub_dict(cfg)
     hub_opt = hub_d["opt_class"](**hub_d["opt_kwargs"])
-    S, K = hub_opt.batch.S, hub_opt.batch.K
+    # the cylinder wire format carries REAL scenarios only: a sharded
+    # hub pads its batch to the mesh (doc/sharding.md) but spokes run
+    # unpadded engines and the window lengths must agree on both sides
+    S, K = getattr(hub_opt, "_S_orig", hub_opt.batch.S), hub_opt.batch.K
     run_id = f"/spw{os.getpid():x}{secrets.token_hex(4)}"
 
     ctx = mp.get_context("spawn")

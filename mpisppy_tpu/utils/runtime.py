@@ -30,3 +30,37 @@ def setup_jax_runtime(f32: bool = False):
     enable_honest_f32()
     jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+_DISTRIBUTED_UP = False
+
+
+def maybe_init_distributed(coordinator) -> bool:
+    """Multi-process JAX over DCN behind the ``RunConfig.coordinator``
+    knob: when ``coordinator`` is set, call
+    ``jax.distributed.initialize`` so every participating process sees
+    the GLOBAL device set and ``parallel/mesh.make_mesh()`` builds a
+    multi-host "scen" axis (the sharded PH step's psums then ride ICI
+    within a host and DCN across hosts — doc/sharding.md). Idempotent;
+    returns True when initialization ran (now or earlier).
+
+    ``coordinator`` is a dict: ``address`` ("host:port", required),
+    ``num_processes``, ``process_id``, optional ``local_device_ids``.
+    Must run BEFORE the backend initializes — call it ahead of engine
+    construction (the CLI and spin_the_wheel_processes both do)."""
+    global _DISTRIBUTED_UP
+    if not coordinator:
+        return False
+    if _DISTRIBUTED_UP:
+        return True
+    import jax
+
+    kw = {"coordinator_address": coordinator["address"]}
+    for src, dst in (("num_processes", "num_processes"),
+                     ("process_id", "process_id"),
+                     ("local_device_ids", "local_device_ids")):
+        if coordinator.get(src) is not None:
+            kw[dst] = coordinator[src]
+    jax.distributed.initialize(**kw)
+    _DISTRIBUTED_UP = True
+    return True

@@ -99,11 +99,42 @@ def hub_dict(cfg: RunConfig, batch=None):
         opt_cls, hub_cls = APH, APHHub
     else:
         opt_cls, hub_cls = LShapedMethod, LShapedHub
+    opt_kwargs = {"batch": batch if batch is not None
+                  else build_batch_for(cfg),
+                  "options": options, **dtype_kw}
+    if cfg.mesh_devices is not None:
+        if cfg.hub in ("ph", "aph") and not cross:
+            # scenario-axis sharding for the hub engine
+            # (doc/sharding.md): 0 = every visible device (the whole
+            # slice — or the whole pod when
+            # utils/runtime.maybe_init_distributed ran first)
+            import warnings
+
+            import jax
+
+            from ..parallel.mesh import make_mesh
+            n_vis = len(jax.devices())
+            if cfg.mesh_devices > n_vis:
+                warnings.warn(
+                    f"mesh_devices={cfg.mesh_devices} exceeds the "
+                    f"{n_vis} visible device(s) — sharding over all "
+                    f"{n_vis} (multi-host runs need the coordinator "
+                    "knob so jax sees the global set, doc/sharding.md)",
+                    RuntimeWarning, stacklevel=2)
+            opt_kwargs["mesh"] = make_mesh(
+                n_devices=min(cfg.mesh_devices, n_vis) or None)
+        else:
+            # the lshaped hub and the cross-scenario cut engine keep
+            # the unsharded path (the cut store is not sharding-
+            # audited) — say so instead of silently dropping the knob
+            import warnings
+            warnings.warn(
+                f"mesh_devices is ignored for this wheel (hub="
+                f"{cfg.hub!r}{', cross_scenario' if cross else ''}): "
+                "scenario-axis sharding covers the ph/aph hubs only "
+                "(doc/sharding.md)", RuntimeWarning, stacklevel=2)
     return {"hub_class": hub_cls, "hub_kwargs": hub_kwargs,
-            "opt_class": opt_cls,
-            "opt_kwargs": {"batch": batch if batch is not None
-                           else build_batch_for(cfg),
-                           "options": options, **dtype_kw}}
+            "opt_class": opt_cls, "opt_kwargs": opt_kwargs}
 
 
 def spoke_classes(kind: str):

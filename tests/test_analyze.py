@@ -413,3 +413,47 @@ def test_counter_catalog_documents_every_metric():
     missing = sorted(n for n in names if n not in doc)
     assert not missing, \
         f"metrics emitted but not in doc/observability.md: {missing}"
+
+
+# ---------------- sharding section (ISSUE 6) ----------------
+
+def test_analyze_sharding_section_and_compare_counters(tmp_path):
+    """A sharded run's telemetry renders the sharding section (devices,
+    shard size, collective bytes/iter, zero device_put) and feeds the
+    collective/device_put per-call counters into --compare metrics."""
+    from mpisppy_tpu.__main__ import config_from_args, make_parser, run
+
+    tdir = tmp_path / "sharded"
+    args = make_parser().parse_args(
+        ["farmer", "--num-scens", "4", "--max-iterations", "3",
+         "--convthresh", "-1", "--subproblem-max-iter", "1500",
+         "--mesh-devices", "2", "--telemetry-dir", str(tdir)])
+    run(config_from_args(args))
+    r = analyze.load_run(str(tdir))
+    sh = analyze.sharding_summary(r)
+    assert sh is not None
+    assert sh["mode"] == "sharded" and sh["n_devices"] == 2
+    assert sh["shard_scenarios"] == 2
+    assert sh["collective_bytes_total"] > 0
+    assert sh.get("collective_bytes_per_iter", 0) > 0
+    # acceptance evidence as analyze reads it: the one-time initial
+    # shard placement is booked, and the steady-state iterations add
+    # NOTHING on top of it
+    assert sh["device_put_bytes_total"] > 0
+    assert sh["device_put_bytes_iterations"] == 0
+    rep = analyze.render_report(r)
+    assert "== sharding ==" in rep
+    assert "devices 2" in rep and "psum operand estimate" in rep
+    m = analyze.comparison_metrics(r)
+    assert ("collective_kbytes_per_solve_call", "count") in m
+    assert m[("device_put_kbytes_across_iterations", "count")] == 0.0
+    # unsharded runs carry no section and no sharded counters
+    # (compare() then skips the keys instead of mis-diffing)
+
+
+def test_analyze_no_sharding_section_on_unsharded_run(farmer_run_dir):
+    r = analyze.load_run(farmer_run_dir)
+    assert analyze.sharding_summary(r) is None
+    assert "== sharding ==" not in analyze.render_report(r)
+    assert ("collective_kbytes_per_solve_call", "count") \
+        not in analyze.comparison_metrics(r)

