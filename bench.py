@@ -306,7 +306,7 @@ def bench_1024():
     # qp.donated_passes), so a BENCH row and a test read one source
     c_after = obs.counters_snapshot()
     ctr_window = {k: c_after[k] - c_before.get(k, 0) for k in c_after
-                  if k.split(".")[0] in ("ph", "qp")} \
+                  if k.split(".")[0] in ("ph", "qp", "kernel")} \
         if obs.enabled() else None
     # packed operand footprint: bytes one split A-pass (hi+lo pair)
     # streams — the hot loop's bandwidth-bound cost basis (see
@@ -316,6 +316,21 @@ def bench_1024():
     if getattr(A, "pk_hi", None) is not None:
         from mpisppy_tpu.ops.packed import pk_nbytes
         pk_mb = round((pk_nbytes(A.pk_hi) + pk_nbytes(A.pk_lo)) / 1e6, 2)
+    # resolved kernel decisions + the roofline traffic model's
+    # prediction (ISSUE 7): the next driver run diffs the measured
+    # s/PH-iter against est_hbm_bytes_per_iter to confirm (or refute)
+    # the predicted traffic drop of the fused/L⁻¹/bf16 trades
+    kern = pt.get("kernel")
+    est_hbm = None
+    if kern is not None:
+        from mpisppy_tpu.ops.kernels import est_hbm_bytes_per_iter
+        m_rows, n_cols = A.shape
+        est_hbm = est_hbm_bytes_per_iter(
+            n=int(n_cols), m=int(m_rows), s_chunk=chunk,
+            pk_pass_bytes=None if pk_mb is None else int(pk_mb * 1e6),
+            ir_sweeps=int(DF32.get("subproblem_ir_sweeps", 1)),
+            l_inv=bool(kern.get("l_inv")),
+            block_dtype=kern.get("block_dtype", "f32"))
     emit({
         "metric": "uc1024_ph_seconds_per_iteration",
         "value": round(sec_per_iter, 3),
@@ -346,6 +361,12 @@ def bench_1024():
                            if ph._shard_ops is not None else S),
         },
         "packed_matvec_mbytes_per_pass": pk_mb,
+        # {mode, backend, l_inv, block_dtype} — the resolved
+        # ops/kernels plan of the timed window (doc/kernels.md)
+        "kernel": kern,
+        # roofline model estimate, bytes one ADMM iteration streams
+        # from HBM per chunk ({"tail": ..., "bulk": ...})
+        "est_hbm_bytes_per_iter": est_hbm,
         "telemetry_counters_timed_window": ctr_window,
     })
     _progress(f"uc1024: pipeline occupancy "

@@ -26,7 +26,7 @@ import json
 import sys
 
 from .utils.config import (AlgoConfig, RunConfig, SpokeConfig, KNOWN_MODELS,
-                           KNOWN_SPOKES, KNOWN_HUBS)
+                           KNOWN_SPOKES, KNOWN_HUBS, KERNEL_MODES)
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -46,6 +46,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--subproblem-max-iter", type=int, default=5000)
     p.add_argument("--subproblem-eps", type=float, default=1e-8)
     p.add_argument("--subproblem-polish-chunk", type=int, default=0)
+    p.add_argument("--subproblem-ir-sweeps", type=int, default=1,
+                   help="df32 x-update iterative-refinement sweeps "
+                        "(doc/roofline.md §2; fused kernel mode "
+                        "supports 1-4)")
+    p.add_argument("--subproblem-kernel-mode", choices=KERNEL_MODES,
+                   default="auto",
+                   help="subproblem kernel backend (doc/kernels.md): "
+                        "'segmented' = host-segmented drivers "
+                        "bit-for-bit, 'fused' = one device program per "
+                        "solve, 'auto' = fused where eligible")
     p.add_argument("--linearize-proximal-terms", action="store_true")
     p.add_argument("--verbose", action="store_true")
     # termination (ref. baseparsers.py:172 two_sided_args)
@@ -99,6 +109,8 @@ def config_from_args(args) -> RunConfig:
         subproblem_max_iter=args.subproblem_max_iter,
         subproblem_eps=args.subproblem_eps,
         subproblem_polish_chunk=args.subproblem_polish_chunk,
+        subproblem_ir_sweeps=args.subproblem_ir_sweeps,
+        subproblem_kernel_mode=args.subproblem_kernel_mode,
         linearize_proximal_terms=args.linearize_proximal_terms,
         verbose=args.verbose,
     )

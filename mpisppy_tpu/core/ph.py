@@ -153,7 +153,7 @@ def _hot_eps(prox_on, sub_eps, sub_eps_hot):
 def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                  sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
                  tail_iter, stall_rel, segment, polish_hot, polish_chunk,
-                 segment_lo=None, ir_sweeps=1, donate=False):
+                 segment_lo=None, ir_sweeps=1, donate=False, kernel=None):
     """The ONE precision-policy + solver dispatch, shared by the fused
     step and the chunked loop (a second copy would silently drift).
 
@@ -167,11 +167,25 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
     (measured: polish reaches ~1e-14 relative from a 1e-4-stalled loop
     point on UC). Defaults keep the strict contract everywhere. The
     polish serves DUAL accuracy (certified bounds) and final primal
-    refinement, so prox-on solves can skip it (subproblem_polish_hot)."""
+    refinement, so prox-on solves can skip it (subproblem_polish_hot).
+
+    ``kernel`` (ops/kernels.KernelPlan or None): a fused-mode plan
+    routes the solve through ONE device program (doc/kernels.md)
+    instead of the host-segmented drivers below; None — including
+    every recovery/hospital caller, which deliberately clears it — is
+    today's segmented path, bit-for-bit."""
     e_pri = _hot_eps(prox_on, sub_eps, sub_eps_hot)
     e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
         else sub_eps
     do_polish = polish_hot or not prox_on
+    if kernel is not None and kernel.mode == "fused":
+        from ..ops import kernels as _kernels
+        return _kernels.kernel_solve(
+            kernel, factors, d, q, qp_state, precision=precision,
+            max_iter=sub_max_iter, tail_iter=tail_iter, e_pri=e_pri,
+            e_dua=e_dua, stall_rel=stall_rel, polish=do_polish,
+            polish_chunk=polish_chunk, ir_sweeps=ir_sweeps,
+            donate=donate)
     if precision in ("mixed", "df32"):
         # df32 differs from mixed only in the data representation (the
         # engine's A is a SplitMatrix, see spbase) — the driver is the
@@ -201,7 +215,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
              polish_chunk, precision="native", tail_iter=1000,
              sub_eps_hot=None, sub_eps_dua_hot=None, stall_rel=0.0,
              segment=500, polish_hot=True, segment_lo=None, ir_sweeps=1,
-             lap=None, combine_fn=None):
+             lap=None, combine_fn=None, kernel=None):
     """The PH iteration: batched subproblem solve + Compute_Xbar +
     Update_W + convergence + objectives + certified dual bound, staged as
     THREE jitted programs (assemble / solve / reduce) rather than one
@@ -233,8 +247,22 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
         sub_eps_hot=sub_eps_hot, sub_eps_dua_hot=sub_eps_dua_hot,
         tail_iter=tail_iter, stall_rel=stall_rel, segment=segment,
         polish_hot=polish_hot, polish_chunk=polish_chunk,
-        segment_lo=segment_lo, ir_sweeps=ir_sweeps)
+        segment_lo=segment_lo, ir_sweeps=ir_sweeps, kernel=kernel)
+    if kernel is not None and kernel.mode == "fused" and obs.enabled():
+        # kernel.fused_iters is booked HERE, not inside kernel_solve:
+        # the scalar iters read blocks on the whole fused program, and
+        # this is the one place the fused path pays that wait anyway
+        # (phase honesty below) — booking earlier would serialize the
+        # solve with its caller's next dispatch
+        obs.counter_add("kernel.fused_iters", int(qp_state.iters))
     if lap is not None:
+        if kernel is not None and kernel.mode == "fused":
+            # phase honesty: a fused program never blocks mid-solve
+            # (the segmented drivers' iteration readbacks did), so the
+            # device wait would otherwise escape the lap anatomy
+            # entirely — it lands at the caller's float(conv) sync,
+            # outside every phase
+            jax.block_until_ready(qp_state.pri_rel)
         lap("solve")
     wmask = None if wscale is None else wscale > 0
     if combine_fn is None:
@@ -327,6 +355,42 @@ class PHBase(SPBase):
         # tolerance; raise for pathologically conditioned models)
         self.sub_ir_sweeps = int(opts.get("subproblem_ir_sweeps", 1))
         self.sub_polish_hot = bool(opts.get("subproblem_polish_hot", True))
+        # kernel-backend selection (ops/kernels, doc/kernels.md):
+        # "segmented" = the host-segmented qp_solver drivers bit-for-bit,
+        # "fused" = one device program per solve, "auto" (default) =
+        # fused wherever the solve is eligible. Validated HERE so a
+        # typo'd programmatic option fails at engine construction, not
+        # as a silent segmented fallback; the fused+ir_sweeps band rule
+        # mirrors utils/config.AlgoConfig.validate (the CLI surface).
+        from ..utils.config import (FUSED_IR_SWEEPS, KERNEL_BACKENDS,
+                                    KERNEL_BLOCK_DTYPES,
+                                    KERNEL_L_INV_MODES, KERNEL_MODES)
+        self.sub_kernel_mode = str(opts.get("subproblem_kernel_mode",
+                                            "auto"))
+        self.sub_kernel_backend = str(opts.get("subproblem_kernel_backend",
+                                               "reference"))
+        self.sub_kernel_l_inv = str(opts.get("subproblem_kernel_l_inv",
+                                             "auto"))
+        self.sub_kernel_block_dtype = str(opts.get(
+            "subproblem_kernel_block_dtype", "auto"))
+        for val, known, name in (
+                (self.sub_kernel_mode, KERNEL_MODES, "mode"),
+                (self.sub_kernel_backend, KERNEL_BACKENDS, "backend"),
+                (self.sub_kernel_l_inv, KERNEL_L_INV_MODES, "l_inv"),
+                (self.sub_kernel_block_dtype, KERNEL_BLOCK_DTYPES,
+                 "block_dtype")):
+            if val not in known:
+                raise ValueError(f"unknown subproblem_kernel_{name} "
+                                 f"{val!r}; known: {known}")
+        if self.sub_kernel_mode == "fused" \
+                and self.sub_ir_sweeps not in FUSED_IR_SWEEPS:
+            raise ValueError(
+                f"subproblem_kernel_mode='fused' supports "
+                f"subproblem_ir_sweeps in [{FUSED_IR_SWEEPS.start}, "
+                f"{FUSED_IR_SWEEPS.stop - 1}] (the fused program "
+                f"unrolls the sweeps statically); got "
+                f"{self.sub_ir_sweeps}")
+        self._kernel_plans = {}  # (factor key, s_chunk) -> KernelPlan
         if self.sub_precision in ("mixed", "df32") \
                 and self.dtype != jnp.float64:
             raise ValueError(f"subproblem_precision={self.sub_precision!r}"
@@ -541,8 +605,35 @@ class PHBase(SPBase):
             self._factors[key] = (fac, d)
         return self._factors[key]
 
+    def _kernel_plan(self, key, factors, s_chunk):
+        """Cached ops/kernels plan for one mode's factors (resolved
+        mode, effective backend, L⁻¹ profitability verdict, the bulk
+        phase's bf16-or-f32 packed operand — doc/kernels.md). Keyed by
+        (factor key, rows-per-solve-call): the L⁻¹ trade's
+        profitability depends on how many RHS columns each fused
+        program back-substitutes. Invalidated with the factor cache —
+        a plan holds (possibly quantized) views of the factors'
+        arrays."""
+        pk = (key, int(s_chunk))
+        plan = self._kernel_plans.get(pk)
+        if plan is None:
+            from ..ops import kernels
+            tail = self.sub_tail_iter \
+                if self.sub_precision in ("mixed", "df32") else 0
+            plan = kernels.prepare(
+                factors, mode=self.sub_kernel_mode,
+                backend=self.sub_kernel_backend,
+                l_inv=self.sub_kernel_l_inv,
+                block_dtype=self.sub_kernel_block_dtype,
+                precision=self.sub_precision,
+                bulk_iter=self.sub_max_iter, tail_iter=tail,
+                ir_sweeps=self.sub_ir_sweeps, s_chunk=s_chunk)
+            self._kernel_plans[pk] = plan
+        return plan
+
     def invalidate_factors(self):
         """Call after changing rho (rho setters / NormRhoUpdater)."""
+        self._kernel_plans.clear()   # plans hold views of the factors
         for cache in (self._factors, self._qp_states):
             cache.pop(True, None)
             cache.pop(("fixed", True), None)
@@ -796,6 +887,16 @@ class PHBase(SPBase):
         polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
         from ..ops.qp_solver import SplitMatrix
         split_mode = isinstance(factors.A_s, SplitMatrix)
+        # kernel plan for THIS mode's factors at this call's PER-DEVICE
+        # batch rows: fused plans route each chunk solve through one
+        # device program; recovery and the hospital below always clear
+        # it (they ARE the full-precision segmented fallback —
+        # doc/kernels.md). Sharded solves hand lc, not lc*n_devices:
+        # the L⁻¹ build replicates on every device while the applies
+        # are sharded, so per-device break-even is what the
+        # profitability check must see (l_inv_profitable).
+        rows_per_call = lc if sharded else chunk
+        plan = self._kernel_plan(key, factors, rows_per_call)
         kw = dict(prox_on=bool(prox_on), precision=self.sub_precision,
                   sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
                   sub_eps_hot=self.sub_eps_hot,
@@ -805,7 +906,7 @@ class PHBase(SPBase):
                   polish_hot=self.sub_polish_hot,
                   polish_chunk=polish_chunk,
                   segment_lo=self.sub_segment_lo,
-                  ir_sweeps=self.sub_ir_sweeps)
+                  ir_sweeps=self.sub_ir_sweeps, kernel=plan)
         pipeline = bool(int(self.options.get("subproblem_pipeline", 1)))
         donate = pipeline and key in self._chunk_donatable \
             and bool(int(self.options.get("subproblem_donate", 1)))
@@ -821,6 +922,7 @@ class PHBase(SPBase):
         ent["calls"] += 1
         ent["devices"] = ops.n_devices if sharded else 1
         ent["mode"] = "sharded" if sharded else "host"
+        ent["kernel"] = plan.descriptor()
         gate_syncs = 0
         # one shared args dict per call (never mutated): lets trace
         # consumers split phase spans by solve mode, allocated only
@@ -929,6 +1031,24 @@ class PHBase(SPBase):
                 # (the unify below re-attaches the flowed factor)
                 st = st._replace(L=jnp.zeros((), jnp.float32))
             solved_chunks[ci] = [st, x, yA, yB, d_c, q_c, factors]
+        if plan.mode == "fused":
+            # phase honesty: fused programs never block mid-solve (no
+            # per-segment iteration readbacks), so without this the
+            # device wait would book under "gate" (the first D2H) and
+            # the solve/occupancy anatomy would read near-zero. Every
+            # chunk is already enqueued — blocking here costs no
+            # cross-chunk pipelining and adds no transfer; the gate
+            # still pays its one D2H below.
+            jax.block_until_ready([rec[0].pri_rel
+                                   for rec in solved_chunks])
+            if obs.enabled():
+                # booked post-block (a scalar copy per chunk, not a
+                # stall) rather than inside kernel_solve, where the
+                # read would serialize chunk k's solve with chunk
+                # k+1's dispatch
+                obs.counter_add(
+                    "kernel.fused_iters",
+                    sum(int(rec[0].iters) for rec in solved_chunks))
         _lap("solve")
         # pass 2 — bounded recovery: a chunk whose warm-started rho
         # trajectory went pathological (per-chunk shared rho adapts on
@@ -1008,8 +1128,11 @@ class PHBase(SPBase):
             # original solve's. Native configs keep their precision
             # (there is no higher tier to escalate to) and just get
             # the bigger budget.
-            # budget >= the original solve's TOTAL (bulk + tail) work
-            kw_r = dict(kw, precision="native",
+            # budget >= the original solve's TOTAL (bulk + tail) work.
+            # kernel=None: recovery ALWAYS takes the segmented path in
+            # native precision — it doubles as the fused path's
+            # full-precision fallback (doc/kernels.md)
+            kw_r = dict(kw, precision="native", kernel=None,
                         sub_max_iter=max(kw["sub_max_iter"]
                                          + 4 * kw["tail_iter"], 1500))
             st2, x2, yA2, yB2 = _solver_call(fac_c, rec[4], rec[5],
@@ -1201,6 +1324,11 @@ class PHBase(SPBase):
             # "sharded": scenario-axis SPMD over the mesh;
             # "host": single-device dispatch (doc/sharding.md)
             "mode": ent.get("mode", "host"),
+            # resolved kernel decisions of the last call ({mode,
+            # backend, l_inv, block_dtype} — ops/kernels.KernelPlan
+            # .descriptor(), doc/kernels.md); None on engines predating
+            # a kernel-plan build
+            "kernel": ent.get("kernel"),
         }
 
     def _phase_totals(self):
@@ -1244,7 +1372,15 @@ class PHBase(SPBase):
                             # bytes == 0 (so device_put only appears in
                             # a record when something went wrong)
                             "xfer.collective_bytes",
-                            "xfer.device_put_bytes")
+                            "xfer.device_put_bytes",
+                            # kernel-backend activity (ops/kernels):
+                            # fused ADMM iterations this iteration, plus
+                            # the (rare) eager L⁻¹ builds and bf16 gate
+                            # trips — the analyze fused-vs-segmented
+                            # verdict row reads these
+                            "kernel.fused_iters",
+                            "kernel.l_inv_factorizations",
+                            "kernel.bf16_fallbacks")
 
     def iteration_record(self, it, seconds, phase_before, counters_before):
         """The structured per-iteration convergence record (the
@@ -1355,7 +1491,7 @@ class PHBase(SPBase):
         # seconds per PH iteration for one sick scenario)
         st_h, x_h, yA_h, yB_h = _solver_call(
             fac_h, d_h, q_h, st_h,
-            **dict(kw, precision="native",
+            **dict(kw, precision="native", kernel=None,
                    sub_max_iter=max(6000, kw["sub_max_iter"]),
                    segment=1500))
         pr_h = np.asarray(st_h.pri_rel)
@@ -1484,6 +1620,13 @@ class PHBase(SPBase):
         ent["calls"] += 1
         ent["devices"] = sh.n_devices if sh is not None else 1
         ent["mode"] = "sharded" if sh is not None else "host"
+        # per-device rows (see _solve_loop_chunked: the profitability
+        # check amortizes the replicated L⁻¹ build against the LOCAL
+        # shard's applies)
+        plan = self._kernel_plan(
+            skey, factors,
+            sh.shard_size if sh is not None else self.batch.S)
+        ent["kernel"] = plan.descriptor()
         acc = ent["acc"]
         sp_args = {"mode": _mode_str(skey)} if obs.enabled() else None
         t_mark = _time.perf_counter()
@@ -1516,7 +1659,7 @@ class PHBase(SPBase):
             polish_hot=self.sub_polish_hot,
             segment_lo=self.sub_segment_lo,
             ir_sweeps=self.sub_ir_sweeps, lap=_lap,
-            combine_fn=combine_fn)
+            combine_fn=combine_fn, kernel=plan)
         self._qp_states[skey] = qp_state
         self.x, self.yA, self.yB = x, yA, yB
         if update:

@@ -592,7 +592,30 @@ def comparison_metrics(run: Run) -> dict:
     h = run.histograms().get("ph.iteration_seconds", {})
     if h.get("p99") is not None:
         out[("ph_iteration_seconds_p99", "time")] = h["p99"]
+    if calls and "kernel.fused_iters" in c:
+        # fused-vs-fused pairings compare kernel iteration volume too
+        # (a jump means the fused programs are burning more budget for
+        # the same work); fused-vs-segmented pairings skip this row —
+        # the dedicated verdict row in compare() handles those
+        out[("kernel_fused_iters_per_solve_call", "count")] = \
+            c["kernel.fused_iters"] / calls
     return out
+
+
+def kernel_summary(run: Run) -> dict:
+    """Kernel-backend activity of one run (the ops/kernels counters,
+    doc/kernels.md): which subproblem kernel mode actually executed and
+    the trade volumes the fused-vs-segmented compare row reports."""
+    c = run.counters()
+    calls = c.get("ph.solve_loop_calls", 0)
+    fused = c.get("kernel.fused_iters", 0)
+    return {
+        "mode": "fused" if fused else "segmented",
+        "fused_iters": fused,
+        "fused_iters_per_solve_call": (fused / calls) if calls else 0.0,
+        "l_inv_factorizations": c.get("kernel.l_inv_factorizations", 0),
+        "bf16_fallbacks": c.get("kernel.bf16_fallbacks", 0),
+    }
 
 
 def compare(a: Run, b: Run, threshold=1.5) -> tuple[str, bool]:
@@ -625,6 +648,28 @@ def compare(a: Run, b: Run, threshold=1.5) -> tuple[str, bool]:
             regressions.append(name)
         L.append(f"  {name}: A={_fmt(va)} B={_fmt(vb)} "
                  f"ratio={_fmt(ratio, 3)} [{tag}]")
+    ka, kb = kernel_summary(a), kernel_summary(b)
+    if ka["fused_iters"] or kb["fused_iters"]:
+        # fused-vs-segmented verdict row (ISSUE 7, doc/kernels.md):
+        # when the two runs executed different subproblem kernel modes,
+        # the per-iteration time rows above ARE the evidence — restate
+        # them against the kernel modes so the pairing reads as one
+        # explicit accept/reject line, not a diff to interpret.
+        per_iter_bad = [r for r in regressions
+                        if r.startswith(("ph_seconds_per_iteration",
+                                         "ph_iteration_seconds",
+                                         "phase_solve"))]
+        tag = "REGRESSION" if per_iter_bad else "PASS"
+        L.append(
+            f"  kernel: A={ka['mode']} "
+            f"({_fmt(ka['fused_iters_per_solve_call'])} fused "
+            f"iters/solve, l_inv={ka['l_inv_factorizations']}, "
+            f"bf16_fallbacks={ka['bf16_fallbacks']}) "
+            f"B={kb['mode']} "
+            f"({_fmt(kb['fused_iters_per_solve_call'])}, "
+            f"l_inv={kb['l_inv_factorizations']}, "
+            f"bf16_fallbacks={kb['bf16_fallbacks']}) — "
+            f"per-iteration verdict [{tag}]")
     only = [k[0] for k in (set(ma) ^ set(mb))]
     if only:
         L.append(f"  (not in both runs, skipped: {sorted(only)})")
@@ -673,6 +718,8 @@ def main(argv=None) -> int:
                            for k, v in comparison_metrics(a).items()},
                      "b": {str(k[0]): v
                            for k, v in comparison_metrics(b).items()},
+                     "kernel": {"a": kernel_summary(a),
+                                "b": kernel_summary(b)},
                      "verdict": "PASS" if passed else "REGRESSION"}))
             else:
                 print(text)

@@ -1,0 +1,179 @@
+"""Pallas TPU backend: the whole ADMM iteration block — x-update
+matmul, relaxed z-projections, dual updates, and the stacked residual
+reduction — as ONE kernel whose operands load into VMEM once and stay
+there for every iteration, instead of the XLA program's one-HBM-round-
+trip-per-op dataflow.
+
+This is the PRIMARY backend for real chips: at chunk scale the fused
+iteration's working set (the (n, n) solve operator + the packed blocks
++ the (S, m)/(S, n) iterates) is what the roofline says the loop
+streams from HBM every iteration — holding it in VMEM across the
+in-kernel ``fori_loop`` converts the bandwidth-bound tail into compute.
+Off-chip (tier-1 CPU), the same kernel runs under ``interpret=True`` so
+the backend's MATH is covered without TPU hardware; the parity test
+pins it against the reference fused-scan backend.
+
+Deliberate scope (the production tiling plan lives in doc/kernels.md):
+
+ - SHARED-structure dense operands only (one (m, n) A, one solve
+   operator) — the representation the chunked PH loop requires anyway;
+ - the solve operator is an EXPLICIT inverse: the f64 M⁻¹ the shared
+   factorization already carries (one MXU matmul per x-update) or the
+   kernel layer's L⁻¹ pair (two matmuls — qp_solver.LInv). Triangular
+   back-substitution has no efficient Pallas spelling, which is the
+   same latency argument behind roofline headroom item 1;
+ - rho is FIXED for the duration of one block (the OSQP adaptation
+   rule needs a refactorization the kernel cannot express) — the
+   driver folds ``state.rho_scale`` into the row patterns and the
+   reference path handles adaptation between blocks;
+ - no grid: one program instance owns the whole chunk. Production
+   tiling splits the scenario axis over the grid with the operator
+   broadcast — shapes here are test-scale.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..qp_solver import LInv, _scaled_problem
+
+try:  # pallas ships with jax>=0.4.30 everywhere this repo runs
+    from jax.experimental import pallas as pl
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    pl = None
+    HAVE_PALLAS = False
+
+__all__ = ["HAVE_PALLAS", "pallas_supported", "fused_admm_block"]
+
+
+def pallas_supported(factors, state) -> bool:
+    """Whether THIS solve's operands fit the kernel's scope: shared
+    dense A with an explicit-inverse solve operator (f64 M⁻¹ or LInv)."""
+    if not HAVE_PALLAS:
+        return False
+    A_s = factors.A_s
+    if getattr(A_s, "ndim", 0) != 2 or not isinstance(A_s, jax.Array):
+        return False
+    L = state.L
+    if isinstance(L, LInv):
+        return True
+    return getattr(L, "ndim", 0) == 2 and L.dtype == jnp.float64
+
+
+def _admm_block_kernel(A_ref, F_ref, Ps_ref, g_ref, q_ref,
+                       l_ref, u_ref, lb_ref, ub_ref, rA_ref, rB_ref,
+                       Einv_ref, Ebinv_ref, Dinvc_ref, D_ref,
+                       x_ref, yA_ref, yB_ref, zA_ref, zB_ref,
+                       ox_ref, oyA_ref, oyB_ref, ozA_ref, ozB_ref,
+                       opri_ref, odua_ref, *, n_steps, sigma, alpha,
+                       l_inv_pair):
+    """The fused iteration block. Mirrors ops/qp_solver._solve_impl's
+    ``one()`` update and ``_unscaled_residuals`` EXACTLY — the parity
+    test compares against those, so any drift here is a test failure,
+    not a silent divergence. ``sigma``/``alpha`` are compile-time
+    constants (closing traced values over a pallas kernel body is not
+    expressible; sigma is constant per factorization anyway)."""
+    A = A_ref[:]
+    F = F_ref[:]
+    Ps, g, q_s = Ps_ref[:], g_ref[:], q_ref[:]
+    l_s, u_s, lb_s, ub_s = l_ref[:], u_ref[:], lb_ref[:], ub_ref[:]
+    rA, rB = rA_ref[:], rB_ref[:]
+
+    def m_solve(rhs):
+        if l_inv_pair:
+            # x = L⁻ᵀ (L⁻¹ rhs): two MXU matmuls of the factor's bytes
+            return (rhs @ F.T) @ F
+        return rhs @ F          # explicit symmetric M⁻¹: one matmul
+
+    def one(i, c):
+        x, yA, yB, zA, zB = c
+        rhs = sigma * x - q_s + (rA * zA - yA) @ A + g * (rB * zB - yB)
+        x_t = m_solve(rhs)
+        x_new = alpha * x_t + (1 - alpha) * x
+        zA_t = x_t @ A.T
+        zA_mix = alpha * zA_t + (1 - alpha) * zA
+        zA_new = jnp.clip(zA_mix + yA / rA, l_s, u_s)
+        yA_new = yA + rA * (zA_mix - zA_new)
+        zB_t = g * x_t
+        zB_mix = alpha * zB_t + (1 - alpha) * zB
+        zB_new = jnp.clip(zB_mix + yB / rB, lb_s, ub_s)
+        yB_new = yB + rB * (zB_mix - zB_new)
+        return x_new, yA_new, yB_new, zA_new, zB_new
+
+    x, yA, yB, zA, zB = jax.lax.fori_loop(
+        0, n_steps, one,
+        (x_ref[:], yA_ref[:], yB_ref[:], zA_ref[:], zB_ref[:]))
+    ox_ref[:] = x
+    oyA_ref[:] = yA
+    oyB_ref[:] = yB
+    ozA_ref[:] = zA
+    ozB_ref[:] = zB
+    # stacked residual reduction, fused: the UNSCALED primal/dual
+    # maxima of _unscaled_residuals, computed while the iterates are
+    # still VMEM-resident (the chunked PH gate consumes exactly these)
+    Einv, Ebinv, Dinv_c, D = (Einv_ref[:], Ebinv_ref[:], Dinvc_ref[:],
+                              D_ref[:])
+    Ax = x @ A.T
+    Aty = yA @ A
+    opri_ref[:] = jnp.maximum(
+        jnp.max(jnp.abs(Einv * (Ax - zA)), axis=1),
+        jnp.max(jnp.abs(D * x - Ebinv * zB), axis=1))
+    odua_ref[:] = jnp.max(
+        jnp.abs(Dinv_c * (Ps * x + q_s + Aty + g * yB)), axis=1)
+
+
+@partial(jax.jit,
+         static_argnames=("sigma", "n_steps", "alpha", "interpret",
+                          "l_inv_pair"))
+def _block_call(A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
+                Einv, Ebinv, Dinv_c, D, x, yA, yB, zA, zB, sigma,
+                n_steps, alpha, interpret, l_inv_pair):
+    S, n = x.shape
+    m = zA.shape[1]
+    dt = x.dtype
+    kern = partial(_admm_block_kernel, n_steps=n_steps, sigma=sigma,
+                   alpha=alpha, l_inv_pair=l_inv_pair)
+    out_shape = [jax.ShapeDtypeStruct((S, n), dt),   # x
+                 jax.ShapeDtypeStruct((S, m), dt),   # yA
+                 jax.ShapeDtypeStruct((S, n), dt),   # yB
+                 jax.ShapeDtypeStruct((S, m), dt),   # zA
+                 jax.ShapeDtypeStruct((S, n), dt),   # zB
+                 jax.ShapeDtypeStruct((S,), dt),     # pri
+                 jax.ShapeDtypeStruct((S,), dt)]     # dua
+    return pl.pallas_call(kern, out_shape=out_shape,
+                          interpret=interpret)(
+        A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
+        Einv, Ebinv, Dinv_c, D, x, yA, yB, zA, zB)
+
+
+def fused_admm_block(factors, data, q, state, n_steps, interpret=None):
+    """Run ``n_steps`` fused ADMM iterations on the scaled problem
+    (factors, data, q) from ``state``; returns (x, yA, yB, zA, zB,
+    pri, dua) — SCALED iterates (the QPState carry convention) plus the
+    unscaled residual maxima. Scaling comes from the shared
+    qp_solver._scaled_problem helper so this block iterates the exact
+    problem _solve_impl would."""
+    if interpret is None:
+        # tier-1 coverage without a chip: interpret everywhere but TPU
+        interpret = jax.default_backend() != "tpu"
+    g, l_s, u_s, lb_s, ub_s, csx, q_s = _scaled_problem(factors, data, q)
+    rs = state.rho_scale
+    rA = factors.rho_A * rs
+    rB = factors.rho_b * rs
+    Einv = 1.0 / factors.E
+    Ebinv = 1.0 / factors.Eb
+    Dinv_c = 1.0 / (factors.D * csx)
+    L = state.L
+    l_inv_pair = isinstance(L, LInv)
+    F = L.inv if l_inv_pair else L
+    return _block_call(factors.A_s, F, factors.P_s, g, q_s,
+                       l_s, u_s, lb_s, ub_s, rA, rB, Einv, Ebinv,
+                       Dinv_c, factors.D, state.x, state.yA, state.yB,
+                       state.zA, state.zB, sigma=float(factors.sigma),
+                       n_steps=int(n_steps), alpha=1.6,
+                       interpret=bool(interpret),
+                       l_inv_pair=l_inv_pair)

@@ -1,0 +1,274 @@
+"""XLA fused-scan REFERENCE backend of the kernel layer (and its
+correctness oracle): one full precision-escalated ADMM solve — f32 bulk
+phase, factor handoff, accurate tail, polish — traced as a SINGLE
+device program, so no iterate, factor, or residual ever round-trips
+through the host between phases.
+
+What this removes, relative to the segmented driver it replaces
+(ops/qp_solver.qp_solve_segmented / qp_solve_mixed):
+
+ - the per-segment host dispatch + blocking ``int(state.iters)`` D2H
+   readback (one per ~100-500 iterations per chunk — at uc1024 scale,
+   8 chunks x 5+ segments of sync per PH iteration);
+ - the per-phase state casts materialized between separate jits (the
+   lo->hi handoff now fuses into the tail's first iteration);
+ - the host's opportunity to interleave — the whole solve is one
+   enqueue, so chunk k+1's assembly genuinely overlaps chunk k's solve
+   in the pipelined PH loop instead of waiting on segment syncs.
+
+The MATH is deliberately not new: both phases call the same
+``_solve_impl`` body every segmented solve runs, so this backend is
+bit-compatible with ``segmented`` whenever the iteration budget fits
+one segment (the micro-parity CI test pins that at 1e-10), and
+tolerance-equivalent beyond (segment boundaries reset the stall window
+and rho-adaptation cadence, which a continuous loop does not — see
+doc/kernels.md).
+
+Two roofline trades live here (doc/roofline.md §5 headroom item 1):
+
+ - ``l_inv``: the df32 tail's two triangular solves become two MXU
+   matmuls of the same bytes by carrying the EXPLICIT L⁻¹
+   (qp_solver.LInv) in the solver state, behind ``l_inv_profitable``
+   (the n-RHS inverse build must amortize over the iteration budget);
+ - bf16 packed blocks: the f32 bulk phase streams the structure-packed
+   A-blocks at half width with f32 accumulation (ops/packed), behind
+   ``bf16_gate`` (entries that bf16 would FLUSH — sub-normal-range
+   magnitudes, 100% relative error — force the f32 fallback).
+   EXPLICIT OPT-IN only: normal-range rounding is ≤ 2⁻⁸, which sounds
+   admissible for a 1e-3-plateau bulk phase, but measured on the UC LP
+   relaxation it relocates the DEGENERATE OPTIMUM by ~35% while the
+   residuals converge normally — the bulk's real job is picking the
+   vertex, and no residual gate can see a wrong-vertex answer. The
+   kernel layer's "auto" therefore never engages bf16 (see
+   prepare()); doc/kernels.md records the measurement.
+
+A solve that goes wrong under either trade is caught by the SAME df32
+gate machinery that already guards the segmented path: the chunked PH
+loop's quality gate retries flagged chunks in native precision through
+the segmented driver (core/ph._solve_loop_chunked pass 2), which uses
+neither bf16 blocks nor the fused program — the recovery path IS the
+full-precision fallback.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import obs
+from ..packed import Packed
+from ..qp_solver import (LInv, PackedMatrix, QPData, QPState, SplitMatrix,
+                         _cast_floats, _factorize, _make_l_inv,
+                         _solve_impl, make_l_inv)
+
+__all__ = ["fused_mixed_solve", "l_inv_profitable", "bf16_gate",
+           "bf16_packed", "BF16_GATE_REL"]
+
+
+# ---------------- roofline trade guards ----------------
+
+def l_inv_profitable(n, s_chunk, tail_iter, ir_sweeps=1):
+    """Whether the explicit L⁻¹ build amortizes. The inverse
+    back-substitutes n RHS columns ONCE; only the TAIL applies it
+    (``s_chunk`` columns ``(1 + ir_sweeps)`` times per iteration — the
+    f32 bulk hands ``LInv.tri`` to the plain back-substitution, see
+    qp_solver.LInv), so the break-even test is one tail's column
+    solves >= the build's n. That is deliberately the margin, not a
+    multiple: the df32 chunk chain flows ONE factor across every chunk
+    and every warm-started PH iteration until rho refactorizes, so
+    each solve past the first applies the same inverse for free —
+    break-even within one solve makes the chain pure win. A short
+    exploratory solve (small s_chunk·tail) still must not pay an
+    (n, n) inversion it never recoups."""
+    applies = int(tail_iter) * (1 + int(ir_sweeps)) * max(int(s_chunk), 1)
+    return applies >= int(n)
+
+
+# bf16 rounds normal-range values within 2⁻⁸ ≈ 3.9e-3 relative —
+# RESIDUAL-level noise the f32 bulk phase tolerates (though NOT
+# objective-level noise on degenerate LPs; that measured hazard is why
+# bf16 is opt-in — see the module docstring). What no consumer can
+# tolerate is INFORMATION LOSS: magnitudes below bf16's normal range
+# flush toward zero (up to 100% relative error), silently deleting
+# matrix entries. The gate measures the worst per-entry relative
+# quantization error and trips above this threshold — normal-range
+# blocks always pass, blocks with flush-range entries always trip.
+BF16_GATE_REL = 1e-2
+
+
+def _bf16_elem_err(vals):
+    """Max per-entry |v - bf16(v)| / |v| over the nonzero entries.
+
+    Measured on HOST via ml_dtypes, deliberately not through an XLA
+    cast: the flush-prone entries are f32 SUBNORMALS (f32 and bf16
+    share the 8-bit exponent, so every f32-normal value is bf16-normal
+    and rounds within 2⁻⁸), and XLA's flush-to-zero erases exactly
+    those entries before the device cast ever sees them — a jitted
+    gate measures 0 error on the blocks it exists to reject. The gate
+    runs once per factorization on small packed blocks, so the host
+    pull is noise."""
+    import ml_dtypes
+
+    v = np.asarray(vals, np.float32)
+    q = v.astype(ml_dtypes.bfloat16).astype(np.float32)
+    nz = np.abs(v) > 0
+    if not nz.any():
+        return 0.0
+    return float((np.abs(v - q)[nz] / np.abs(v)[nz]).max())
+
+
+def bf16_gate(pk: Packed, gate_rel=BF16_GATE_REL):
+    """(trips, measured_err) for bf16 storage of one packed block set."""
+    err = _bf16_elem_err(pk.l_vals)
+    if pk.g_rows.size:
+        err = max(err, _bf16_elem_err(pk.g_vals))
+    return err > gate_rel, err
+
+
+def bf16_packed(pk: Packed) -> Packed:
+    """bf16-storage twin of a packed f32 block set (indices shared; the
+    matvecs keep f32 accumulation — ops/packed._pk_einsum)."""
+    return pk._replace(g_vals=pk.g_vals.astype(jnp.bfloat16),
+                       l_vals=pk.l_vals.astype(jnp.bfloat16))
+
+
+# ---------------- the fused mixed/df32 program ----------------
+
+def _fused_mixed_impl(factors, A_lo, data, q, iterates, aux,
+                      eps_abs, eps_rel, eps_abs_dua, eps_rel_dua, *,
+                      bulk_iter, tail_iter, check_every, adaptive_rho,
+                      polish, polish_iters, polish_chunk, stall_rel,
+                      ir_sweeps, l_inv, alpha=1.6):
+    """Traceable body of the fused precision-escalated solve. Faithful
+    to qp_solve_mixed's phase semantics (same eps floors, same factor
+    handoff, same budget split) with the host segment loops replaced by
+    the in-jit while_loops ``_solve_impl`` already owns.
+
+    ``iterates`` = (x, yA, yB, zA, zB) — donated by the donating twin;
+    ``aux`` = (L, rho_scale, iters) — NEVER donated: the df32 chunked
+    loop deliberately shares one flowed factor across every chunk state
+    (core/ph pass-3 unify), so L is not uniquely owned and must be
+    copied, exactly as qp_solve_mixed's ``owned_lo = donate and not
+    split`` protects it today."""
+    x, yA, yB, zA, zB = iterates
+    L, rho_scale, iters0 = aux
+    S = x.shape[0]
+    dt_hi = x.dtype
+    inf0 = jnp.full((S,), jnp.inf, dt_hi)
+    state = QPState(x=x, yA=yA, yB=yB, zA=zA, zB=zB, L=L,
+                    rho_scale=rho_scale, iters=iters0, pri_res=inf0,
+                    dua_res=inf0, pri_rel=inf0, dua_rel=inf0)
+    lo = jnp.float32
+    split = isinstance(factors.A_s, SplitMatrix)
+    if not isinstance(A_lo, (SplitMatrix, PackedMatrix)) \
+            and getattr(A_lo, "dtype", lo) != lo:
+        # non-split mixed: the plan stages the RAW dense operand and
+        # the bulk casts it in-trace, exactly as qp_solve_mixed's eager
+        # _cast_floats does (a packed A_lo is already f32/bf16 storage)
+        A_lo = A_lo.astype(lo)
+
+    # lo-phase operands: factors cast around the pre-staged A_lo (cast
+    # AFTER detaching A_s — _cast_floats on a bf16 packed block would
+    # widen the very arrays the trade narrows)
+    f_lo = _cast_floats(factors._replace(A_s=jnp.zeros((), lo)), lo)
+    f_lo = f_lo._replace(A_s=A_lo)
+    d_lo = QPData(P_diag=data.P_diag.astype(lo), A=A_lo,
+                  l=data.l.astype(lo), u=data.u.astype(lo),
+                  lb=data.lb.astype(lo), ub=data.ub.astype(lo))
+    st_lo = _cast_floats(state, lo)
+    L_lo0, rho_lo0 = st_lo.L, st_lo.rho_scale
+    if split and isinstance(L_lo0, LInv):
+        # the bulk never applies the explicit inverse (its un-refined
+        # x-update hands L.tri to the back-substitution — see LInv), so
+        # carry the RAW factor through the bulk loop: an LInv carry
+        # would make every in-bulk rho refactorization rebuild an n-RHS
+        # inverse it immediately discards. The handoff below restores
+        # the flowed inverse when rho never moved, and builds a fresh
+        # one exactly once when it did.
+        st_lo = st_lo._replace(L=L_lo0.tri)
+    if not split:
+        st_lo = st_lo._replace(L=_factorize(f_lo, st_lo.rho_scale))
+    # the f32 phase is a WARM START for the tail: same noise-floor
+    # clamps as qp_solve_mixed
+    eps_lo = jnp.maximum(jnp.asarray(eps_abs, lo), 1e-4)
+    eps_rel_lo = jnp.maximum(jnp.asarray(eps_rel, lo), 1e-3)
+    eps_rel_lo_dua = jnp.maximum(jnp.asarray(eps_rel_dua, lo), 1e-2)
+    st_lo, _, _, _ = _solve_impl(
+        f_lo, d_lo, q.astype(lo), st_lo, bulk_iter, check_every,
+        eps_lo, eps_rel_lo, alpha, adaptive_rho, False, polish_iters, 0,
+        eps_lo, eps_rel_lo_dua, stall_rel)
+
+    # handoff: rho and (in split mode) the f32 factor carry over — the
+    # factorization's (n, n) transients are the biggest allocations in
+    # the whole solve path, so the tail must not rebuild one the bulk
+    # already holds
+    rho_hi = st_lo.rho_scale.astype(dt_hi)
+    L_lo = st_lo.L
+    st_hi = _cast_floats(st_lo._replace(L=jnp.zeros((), lo)), dt_hi)
+    if split:
+        L_hi = L_lo
+        if l_inv and not isinstance(L_hi, LInv):
+            # the bulk carries the raw factor (stripped above), so THIS
+            # is where the tail's explicit inverse comes from. The
+            # factor is a pure function of rho_scale, so when the
+            # bulk's rho adaptation never moved it the flowed inverse
+            # from the chunk chain is still exact — reuse it; build a
+            # fresh one (once per solve, not once per in-bulk
+            # refactorization) only when rho actually changed.
+            if isinstance(L_lo0, LInv):
+                L_hi = jax.lax.cond(
+                    jnp.all(st_lo.rho_scale == rho_lo0),
+                    lambda: L_lo0, lambda: _make_l_inv(L_lo))
+            else:
+                L_hi = _make_l_inv(L_hi)
+    else:
+        L_hi = _factorize(factors, rho_hi)
+    st_hi = st_hi._replace(L=L_hi, rho_scale=rho_hi)
+    st, x_un, yA_un, yB_un = _solve_impl(
+        factors, data, q, st_hi, tail_iter, check_every, eps_abs,
+        eps_rel, alpha, adaptive_rho, polish, polish_iters, polish_chunk,
+        eps_abs_dua, eps_rel_dua, stall_rel, ir_sweeps)
+    st = st._replace(iters=st_lo.iters + st.iters)
+    return st, x_un, yA_un, yB_un
+
+
+_FUSED_STATICS = ("bulk_iter", "tail_iter", "check_every", "adaptive_rho",
+                  "polish", "polish_iters", "polish_chunk", "stall_rel",
+                  "ir_sweeps", "l_inv", "alpha")
+_fused_mixed_jit = jax.jit(_fused_mixed_impl, static_argnames=_FUSED_STATICS)
+# donated twin: consumes the ITERATE buffers only (see _fused_mixed_impl
+# on why aux must be copied)
+_fused_mixed_jit_donated = jax.jit(_fused_mixed_impl,
+                                   static_argnames=_FUSED_STATICS,
+                                   donate_argnames=("iterates",))
+
+
+def fused_mixed_solve(factors, A_lo, data, q, state, *, bulk_iter,
+                      tail_iter, check_every, eps_abs, eps_rel,
+                      eps_abs_dua, eps_rel_dua, polish, polish_iters,
+                      polish_chunk, stall_rel, ir_sweeps, l_inv,
+                      donate=False):
+    """One fused mixed/df32 solve call (see _fused_mixed_impl).
+    ``l_inv`` states arriving with a raw 2-D f32 Cholesky factor are
+    wrapped to LInv EAGERLY so the jit sees one pytree structure for the
+    whole chunk chain (a mid-chain structure flip would recompile the
+    UC-sized program)."""
+    if l_inv and not isinstance(state.L, LInv):
+        L = state.L
+        if getattr(L, "ndim", 0) == 2 and L.dtype == jnp.float32:
+            obs.counter_add("kernel.l_inv_factorizations")
+            state = state._replace(L=make_l_inv(L))
+    iterates = (state.x, state.yA, state.yB, state.zA, state.zB)
+    aux = (state.L, state.rho_scale, state.iters)
+    fn = _fused_mixed_jit_donated if donate else _fused_mixed_jit
+    return fn(factors, A_lo, data, q, iterates, aux,
+              eps_abs, eps_rel, eps_abs_dua, eps_rel_dua,
+              bulk_iter=int(bulk_iter), tail_iter=int(tail_iter),
+              check_every=int(check_every),
+              adaptive_rho=True, polish=bool(polish),
+              polish_iters=int(polish_iters),
+              polish_chunk=int(polish_chunk), stall_rel=float(stall_rel),
+              ir_sweeps=int(ir_sweeps), l_inv=bool(l_inv))

