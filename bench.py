@@ -475,6 +475,17 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
                     f"({note})",
             "vs_baseline": 0.0,
         })
+    # bound-flow ledger of the timed wheel window (ISSUE 8): per-spoke
+    # publish/consume counts, lag, staleness tails and reject reasons —
+    # so a DNF row carries the starved-vs-slow-vs-rejected diagnosis
+    # (ROADMAP item 1) instead of just the wall clock at kill. Same
+    # source as /status and live.json (Hub.bound_flow_status); rides
+    # the FIRST gap row so the SIGTERM flush captures it too.
+    if rows and hasattr(hub, "bound_flow_status"):
+        try:
+            rows[0]["bound_flow"] = hub.bound_flow_status()
+        except Exception:
+            pass    # a kill-path flush must never die on diagnostics
     return rows
 
 

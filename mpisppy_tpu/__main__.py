@@ -75,6 +75,20 @@ def make_parser() -> argparse.ArgumentParser:
                         "trace-event; load in Perfetto) and "
                         "metrics.json under this directory — see "
                         "doc/observability.md")
+    p.add_argument("--status-port", type=int, default=None,
+                   help="serve live run state from the hub process "
+                        "while it iterates: /metrics (Prometheus text "
+                        "exposition of the telemetry registry) and "
+                        "/status (JSON: bounds, gap, per-spoke "
+                        "supervisor state + bound flow). 0 binds an "
+                        "ephemeral port. See doc/observability.md "
+                        "(live plane); --telemetry-dir also gets a "
+                        "tailable live.json without the port")
+    p.add_argument("--status-host", type=str, default="127.0.0.1",
+                   help="bind host for --status-port (default "
+                        "loopback; the endpoints serve full run state "
+                        "unauthenticated — pass 0.0.0.0 only to opt "
+                        "into remote scraping)")
     p.add_argument("--wheel-deadline", type=float, default=None,
                    help="watchdog: cleanly terminate the wheel after "
                         "this many seconds (kill signal to spokes, "
@@ -135,6 +149,7 @@ def config_from_args(args) -> RunConfig:
         spokes=spokes, rel_gap=args.rel_gap, abs_gap=args.abs_gap,
         solve_ef=args.solve_ef, ef_integer=args.ef_integer,
         trace_prefix=args.trace_prefix, telemetry_dir=args.telemetry_dir,
+        status_port=args.status_port, status_host=args.status_host,
         wheel_deadline=args.wheel_deadline,
         mesh_devices=args.mesh_devices, coordinator=coordinator,
     ).validate()

@@ -17,7 +17,7 @@ import time
 import numpy as np
 
 from .. import global_toc, obs
-from .spcommunicator import SPCommunicator, Window
+from .spcommunicator import SPCommunicator, Window, split_wire
 from .spoke import ConvergerSpokeType
 
 
@@ -71,6 +71,43 @@ class Hub(SPCommunicator):
         # reach fire_watchdog — the once-guard must be atomic
         self._watchdog_lock = threading.Lock()
         self._reject_warned = set()     # spokes already WARNed about
+        # ---- bound-flow lineage (doc/observability.md live plane) ----
+        # per-spoke flow state, fed by _consume_window +
+        # _book_flow_publish: produced = publishes the spoke stamped
+        # (including ones the hub never read — the window overwrites in
+        # place, so a missed publish shows up as a lineage-seq jump),
+        # consumed = fresh publishes this hub actually read. Maintained
+        # unconditionally (the /status endpoint and live.json need it
+        # with telemetry off); metric booking is gated on
+        # obs.enabled(). The lock covers hub-thread mutation vs
+        # status-server HTTP-thread reads — a dict copy racing a
+        # first-time reject-reason insert would raise mid-iteration
+        # and 500 the scrape.
+        self._flow_lock = threading.Lock()
+        self._spoke_flow = [self._new_flow() for _ in self.spokes]
+        # in-run status server (obs/live.py), owned by the hub process:
+        # opt-in via the "status_port" option (RunConfig.status_port /
+        # --status-port; 0 = ephemeral port)
+        self._status_server = None
+        port = self.options.get("status_port")
+        if port is not None:
+            from ..obs.live import LiveStatusServer
+            self._status_server = LiveStatusServer(
+                self, int(port),
+                host=str(self.options.get("status_host",
+                                          "127.0.0.1"))).start()
+        # live.json snapshot throttle (atomic rename on every
+        # termination check, rate-limited so ms-scale toy iterations
+        # don't turn the hub loop into an fsync benchmark)
+        self._live_last_write = 0.0
+        self._live_min_interval = float(
+            self.options.get("live_snapshot_interval", 0.25))
+
+    @staticmethod
+    def _new_flow():
+        return {"last_seq": 0.0, "produced": 0, "consumed": 0,
+                "accepted": 0, "rejected": 0, "rejects": {},
+                "staleness_last": None, "gen": 0}
 
     # ---- topology (ref. hub.py:245-308 + spcommunicator.py:97) ----
     def classify_spokes(self):
@@ -152,10 +189,21 @@ class Hub(SPCommunicator):
         """Quarantine one payload instead of installing it: counted,
         evented, reported to the supervisor (enough rejections retire
         the spoke), never raised — a corrupt spoke must not crash the
-        wheel it failed to poison."""
+        wheel it failed to poison.
+
+        Per-READ accounting only: the quarantine policy deliberately
+        counts every re-read of the same corrupt wire (heartbeat
+        pulses included) — the per-PUBLISH flow ledger is settled once
+        per fresh publish in :meth:`_book_flow_publish`, or one noisy
+        crossed bound, re-pulsed for minutes, would drown the
+        REJECTED-verdict ratio."""
         obs.counter_add("hub.bound_rejected")
         if reason == "crossed":
             obs.counter_add("hub.bound_crossed")
+        if obs.enabled():
+            # by-reason breakdown sums to hub.bound_rejected (both
+            # count every read)
+            obs.counter_add(f"hub.bound_rejected.{reason}")
         obs.event("hub.bound_rejected",
                   {"spoke": spoke, "kind": kind, "char": char,
                    "value": obs.finite_or_none(value), "reason": reason})
@@ -172,15 +220,107 @@ class Hub(SPCommunicator):
                 and reason != "crossed":
             self.supervisor.note_rejection(spoke)
 
+    # ---- window consumption + bound-flow lineage ----
+    def _consume_window(self, i, sp):
+        """THE freshness-checked read of spoke ``i``'s window — the one
+        body behind every hub read path (base bounds AND subclass cut
+        traffic), so the write-id accounting and the per-spoke lineage
+        bookkeeping cannot drift apart. Returns ``None`` when the
+        window is stale or killed, else ``(payload, fresh)``: the
+        SEMANTIC payload with the lineage suffix stripped, and whether
+        this read carried a fresh publish (lineage seq advanced —
+        False for idle heartbeat re-stamps, which only bump the
+        write-id; True for lineage-less payloads, the legacy
+        behavior)."""
+        values, wid = sp.my_window.read()
+        if wid == Window.KILL or wid <= self._spoke_last_ids[i]:
+            return None
+        self._spoke_last_ids[i] = wid
+        obs.counter_add("hub.window_reads")
+        payload, seq, _t_compute, t_publish = split_wire(values)
+        flow = self._spoke_flow[i]
+        if math.isnan(seq):
+            # no lineage (startup hello, pre-lineage producer): consume
+            # the payload, book nothing, treat it as a fresh publish
+            return payload, True
+        fresh = seq != flow["last_seq"]
+        if fresh:
+            # seq < last_seq means a respawned incarnation restarted
+            # its counter: its `seq` publishes are all new to us
+            step = seq - flow["last_seq"] if seq > flow["last_seq"] \
+                else seq
+            staleness = time.time() - t_publish
+            with self._flow_lock:
+                flow["produced"] += int(step)
+                flow["consumed"] += 1
+                flow["last_seq"] = seq
+                flow["staleness_last"] = staleness
+                produced, consumed = flow["produced"], flow["consumed"]
+            if obs.enabled():
+                obs.histogram_observe(
+                    f"hub.spoke.staleness_seconds.spoke{i}", staleness)
+                obs.gauge_set(f"hub.spoke.produced_writes.spoke{i}",
+                              produced)
+                obs.gauge_set(f"hub.spoke.consumed_writes.spoke{i}",
+                              consumed)
+                obs.gauge_set(f"hub.spoke.lag.spoke{i}",
+                              produced - consumed)
+        return payload, fresh
+
+    def note_spoke_respawn(self, i, gen):
+        """Supervisor hook: spoke ``i`` restarts as generation ``gen``
+        on a fresh window pair — its publish seq restarts at 1, so the
+        flow tracker must not mistake the first new publish for a
+        replay (the seq<last_seq fallback in _consume_window also
+        covers it; this makes the common path exact)."""
+        if i < len(self._spoke_flow):
+            with self._flow_lock:
+                self._spoke_flow[i]["last_seq"] = 0.0
+                self._spoke_flow[i]["gen"] = gen
+
+    def _book_flow_publish(self, i, verdicts):
+        """Settle ONE fresh publish into spoke ``i``'s flow ledger from
+        its per-side ingest verdicts. A publish counts ACCEPTED when
+        any side installed (a dual-typed spoke's healthy side keeps
+        driving the gap — half-installed traffic must not read as
+        quarantined), REJECTED only when no side installed and at
+        least one was quarantined — so ``accepted + rejected`` counts
+        distinct publishes, the ratio the bound-flow verdicts diagnose
+        against. All-None (NaN startup hello) books nothing. Heartbeat
+        re-reads never reach here (``fresh`` gating in the callers)."""
+        verdicts = [v for v in verdicts if v is not None]
+        if not verdicts or i is None or i >= len(self._spoke_flow):
+            return
+        accepted = any(v == "accepted" for v in verdicts)
+        with self._flow_lock:
+            flow = self._spoke_flow[i]
+            if accepted:
+                flow["accepted"] += 1
+            else:
+                reason = verdicts[0][1]
+                flow["rejected"] += 1
+                flow["rejects"][reason] = \
+                    flow["rejects"].get(reason, 0) + 1
+        if obs.enabled():
+            obs.counter_add(f"hub.spoke.bounds_accepted.spoke{i}"
+                            if accepted
+                            else f"hub.spoke.bounds_rejected.spoke{i}")
+
     def _ingest_bound(self, i, sp, kind, value):
-        """One validated bound install from spoke ``i``'s window."""
+        """One validated bound install from spoke ``i``'s window.
+        Returns the side's flow verdict — ``None`` ("no value yet":
+        NaN hello / unset side of a dual window), ``"accepted"``, or
+        ``("rejected", reason)`` — for the CALLER to settle into one
+        per-publish ledger entry via :meth:`_book_flow_publish` (a
+        dual-typed spoke ingests two sides per publish; booking here
+        would double-count)."""
         v = float(value)
         if math.isnan(v):
-            return            # "no value yet" (startup hello / one side)
+            return None       # "no value yet" (startup hello / one side)
         char = sp.converger_spoke_char
         if math.isinf(v):
             self._reject_bound(i, kind, char, v, "nonfinite")
-            return
+            return ("rejected", "nonfinite")
         # implausible magnitude: finite garbage (bit-corrupted doubles,
         # the injector's 'garbage' mode at ~1e30) would otherwise
         # install uncontested while the opposite side is still unset
@@ -189,23 +329,27 @@ class Hub(SPCommunicator):
         # the default cap; models that legitimately do can raise it.
         if abs(v) > float(self.options.get("bound_magnitude_cap", 1e25)):
             self._reject_bound(i, kind, char, v, "implausible")
-            return
+            return ("rejected", "implausible")
         # crossed-bound corruption: in a MIN problem a true outer bound
         # can never sit above a feasible inner bound (beyond noise)
         if kind == "outer" and math.isfinite(self.BestInnerBound) \
                 and v > self.BestInnerBound \
                 + self._crossed_tol(self.BestInnerBound):
             self._reject_bound(i, kind, char, v, "crossed")
-            return
+            return ("rejected", "crossed")
         if kind == "inner" and math.isfinite(self.BestOuterBound) \
                 and v < self.BestOuterBound \
                 - self._crossed_tol(self.BestOuterBound):
             self._reject_bound(i, kind, char, v, "crossed")
-            return
+            return ("rejected", "crossed")
+        # passed validation: an ACCEPTED side (whether or not it
+        # improves the best bound — a spoke republishing a
+        # non-improving bound is healthy traffic)
         if kind == "outer":
             self.OuterBoundUpdate(v, char)
         else:
             self.InnerBoundUpdate(v, char)
+        return "accepted"
 
     def first_nontrivial_outer_time(self):
         """perf_counter stamp of the first outer-bound improvement that
@@ -254,16 +398,23 @@ class Hub(SPCommunicator):
             is_inner = i in self.inner_bound_spoke_indices
             if not is_outer and not is_inner:
                 continue
-            values, wid = sp.my_window.read()
-            if wid <= self._spoke_last_ids[i]:
+            res = self._consume_window(i, sp)
+            if res is None:
                 continue
-            self._spoke_last_ids[i] = wid
-            obs.counter_add("hub.window_reads")
+            values, fresh = res
+            verdicts = []
             if is_outer:
-                self._ingest_bound(i, sp, "outer", values[0])
+                verdicts.append(
+                    self._ingest_bound(i, sp, "outer", values[0]))
             if is_inner:
-                self._ingest_bound(i, sp, "inner",
-                                   values[1] if is_outer else values[0])
+                verdicts.append(self._ingest_bound(
+                    i, sp, "inner",
+                    values[1] if is_outer else values[0]))
+            if fresh:
+                # one ledger entry per publish, however many sides it
+                # carried (heartbeat re-reads re-ingest above for the
+                # quarantine policy but never book)
+                self._book_flow_publish(i, verdicts)
 
     # ---- gap + termination (ref. hub.py:72-137) ----
     def compute_gaps(self):
@@ -274,6 +425,106 @@ class Hub(SPCommunicator):
         nano = abs(self.BestInnerBound)
         rel_gap = abs_gap / nano if nano > 1e-10 else math.inf
         return abs_gap, rel_gap
+
+    # ---- the live plane (obs/live.py, doc/observability.md) ----
+    def bound_flow_status(self):
+        """Per-spoke bound-flow ledger: publishes produced vs consumed,
+        accept/reject verdicts, staleness. The one source behind
+        /status, live.json, the bench ``bound_flow`` block, and (after
+        the run, via the booked metrics) analyze's bound-flow section."""
+        out = {}
+        for i, f in enumerate(self._spoke_flow):
+            with self._flow_lock:   # vs hub-thread ledger mutation
+                ent = {"char": getattr(self.spokes[i],
+                                       "converger_spoke_char", "?"),
+                       "produced": f["produced"],
+                       "consumed": f["consumed"],
+                       "lag": f["produced"] - f["consumed"],
+                       "accepted": f["accepted"],
+                       "rejected": f["rejected"],
+                       "rejects_by_reason": dict(f["rejects"]),
+                       "staleness_last_seconds": f["staleness_last"]}
+            h = obs.histogram_snapshot(
+                f"hub.spoke.staleness_seconds.spoke{i}")
+            if h is not None:
+                ent["staleness_p50_seconds"] = h.get("p50")
+                ent["staleness_p99_seconds"] = h.get("p99")
+            out[f"spoke{i}"] = ent
+        return out
+
+    def status_snapshot(self):
+        """One JSON-ready view of the live wheel: run identity,
+        iteration, bounds + gap, per-spoke supervisor state and bound
+        flow, phase occupancy. Served by /status and persisted as
+        live.json — every field must stay plain-JSON (the consumers are
+        jax-free tails on other hosts)."""
+        fin = obs.finite_or_none
+        abs_gap, rel_gap = self.compute_gaps()
+        rec = obs.active()
+        sup = self.supervisor
+        spokes = []
+        flow = self.bound_flow_status()
+        for i, sp in enumerate(self.spokes):
+            cls = getattr(sp, "_spoke_cls", type(sp))
+            ent = {"index": i, "spoke": cls.__name__,
+                   "state": "running", "gen": self._spoke_flow[i]["gen"],
+                   "crashes": 0, "rejections": 0,
+                   **flow.get(f"spoke{i}", {})}
+            if sup is not None and i < len(sup.health):
+                h = sup.health[i]
+                ent.update(state=h.state, gen=h.gen, crashes=h.crashes,
+                           rejections=h.rejections,
+                           kind=sup.kinds[i])
+                p = sup.procs[i]
+                try:
+                    ent["alive"] = bool(p.is_alive())
+                except Exception:
+                    pass
+            spokes.append(ent)
+        snap = {"type": "live", "schema": obs.SCHEMA_VERSION,
+                "run_id": rec.run_id if rec is not None else None,
+                "hub": type(self).__name__,
+                "wall_time_unix": time.time(),
+                "t": time.perf_counter(),
+                "elapsed_seconds": time.monotonic() - self._wheel_t0,
+                "iter": getattr(self.opt, "_iter", None),
+                "outer": fin(self.BestOuterBound),
+                "inner": fin(self.BestInnerBound),
+                "abs_gap": fin(abs_gap), "rel_gap": fin(rel_gap),
+                "ob_char": self.latest_ob_char,
+                "ib_char": self.latest_ib_char,
+                "watchdog_fired": self._watchdog_fired,
+                "spokes": spokes}
+        try:
+            pt = self.opt.phase_timing(True) \
+                if hasattr(self.opt, "phase_timing") else None
+        except Exception:   # a racing hub thread must never 500 /status
+            pt = None
+        if pt is not None:
+            snap["phases"] = {
+                "mode": pt.get("mode"),
+                "occupancy": pt.get("occupancy"),
+                "seconds_per_call": pt.get("seconds_per_call")}
+        return snap
+
+    def _write_live_snapshot(self, force=False):
+        """Persist live.json beside the telemetry artifacts (atomic
+        rename, so a SIGKILL mid-write can never leave a torn file).
+        Rate-limited except on ``force`` (watchdog / finalize)."""
+        rec = obs.active()
+        if rec is None or not rec.out_dir:
+            return
+        now = time.monotonic()
+        if not force and now - self._live_last_write \
+                < self._live_min_interval:
+            return
+        self._live_last_write = now
+        from ..obs.live import write_live_snapshot
+        try:
+            write_live_snapshot(rec.out_dir, self.status_snapshot())
+            obs.counter_add("hub.live_snapshots")
+        except OSError:
+            pass    # a full disk must not kill the wheel it observes
 
     # ---- wheel watchdog (doc/fault_tolerance.md) ----
     def fire_watchdog(self, source):
@@ -298,6 +549,7 @@ class Hub(SPCommunicator):
                    f"{self.BestInnerBound:.6g}")
         # nonblocking: the timer thread may interrupt a frame holding a
         # sink lock (the same contract as bench's signal-handler flush)
+        self._write_live_snapshot(force=True)
         obs.flush(nonblocking=True)
         self.send_terminate()
 
@@ -326,7 +578,19 @@ class Hub(SPCommunicator):
                       {"iter": getattr(self.opt, "_iter", None),
                        "outer": fin(self.BestOuterBound),
                        "inner": fin(self.BestInnerBound),
-                       "abs_gap": fin(abs_gap), "rel_gap": fin(rel_gap)})
+                       "abs_gap": fin(abs_gap), "rel_gap": fin(rel_gap),
+                       # bound-flow time series: produced vs consumed
+                       # per spoke at every check — analyze's
+                       # silent-starvation invariant reads exactly this
+                       # (produced advancing while consumed stays flat)
+                       "flow": {f"spoke{i}": {"produced": f["produced"],
+                                              "consumed": f["consumed"]}
+                                for i, f in enumerate(self._spoke_flow)}
+                       if self._spoke_flow else None})
+        # the live plane's jax-free tail surface: an atomically-renamed
+        # snapshot beside the telemetry artifacts on every termination
+        # check (rate-limited; obs/live.py)
+        self._write_live_snapshot()
         # rel-gap milestone stamps: the "gap_marks" hub option lists
         # thresholds whose first crossing instant is recorded in
         # self.gap_mark_times (time-to-gap benchmarks read these;
@@ -380,7 +644,23 @@ class Hub(SPCommunicator):
         global_toc(f"Final bounds: outer {self.BestOuterBound:.4f} / inner "
                    f"{self.BestInnerBound:.4f}, rel gap "
                    f"{100 * rel_gap:.4f}%")
+        # the live plane winds down with the wheel: one final snapshot
+        # (so live.json's last state IS the final state), then the
+        # status server releases its port
+        self._write_live_snapshot(force=True)
+        self.shutdown_live()
         return self.BestOuterBound, self.BestInnerBound
+
+    def shutdown_live(self):
+        """Release the status server's port. Idempotent; ALSO called
+        from the wheel launchers' exception paths (sputils /
+        multiproc) — a crashed wheel must not leave a daemon thread
+        squatting on a fixed --status-port for the process lifetime
+        (SO_REUSEADDR cannot rebind an actively LISTENING socket, so
+        the next in-process run would get EADDRINUSE)."""
+        if self._status_server is not None:
+            self._status_server.stop()
+            self._status_server = None
 
     def main(self):
         raise NotImplementedError
@@ -457,11 +737,10 @@ class CrossScenarioHub(PHHub):
             self.opt.batch.K
         for i in self.cut_spoke_indices:
             sp = self.spokes[i]
-            values, wid = sp.my_window.read()
-            if wid == sp.my_window.KILL or wid <= self._spoke_last_ids[i]:
+            res = self._consume_window(i, sp)
+            if res is None:
                 continue
-            self._spoke_last_ids[i] = wid
-            obs.counter_add("hub.window_reads")
+            values, fresh = res
             if np.isnan(values).all():
                 # a process spoke's startup hello (all-NaN payload) —
                 # consumed for readiness, never installed as cuts
@@ -472,9 +751,14 @@ class CrossScenarioHub(PHHub):
                 # store — quarantine the payload, keep the wheel
                 self._reject_bound(i, "cuts", sp.converger_spoke_char,
                                    None, "row_nonfinite")
+                if fresh:
+                    self._book_flow_publish(
+                        i, [("rejected", "row_nonfinite")])
                 continue
             rows = values.reshape(S, 1 + K)
             self.opt.add_cuts(rows[:, 0], rows[:, 1:])
+            if fresh:
+                self._book_flow_publish(i, ["accepted"])
         super().receive_bounds()
 
 

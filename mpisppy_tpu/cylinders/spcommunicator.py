@@ -19,6 +19,44 @@ import weakref
 
 import numpy as np
 
+# ---- bound-flow lineage (doc/observability.md "live plane") ----
+# Every spoke→hub window carries a 3-double lineage SUFFIX behind the
+# semantic payload: [publish seq, compute stamp, publish stamp].
+#  - publish seq: per-spoke monotonically increasing PUBLISH counter.
+#    Distinct from the window write-id, which also advances on idle
+#    heartbeat re-stamps (cylinders/spoke._heartbeat) — the seq is how
+#    the hub tells a fresh bound from a pulse, and how it counts
+#    publishes it never saw (the window overwrites in place, so a slow
+#    reader observes the seq jump).
+#  - compute/publish stamps: ``time.time()`` wall clock — the one clock
+#    hub and spoke PROCESSES share (perf_counter is per-process
+#    monotonic and cannot cross a process boundary). Staleness
+#    (hub read − spoke publish) therefore carries NTP-slew noise, which
+#    is harmless at the >=0.1 s granularity bound flow cares about.
+# NaN lineage (the all-NaN startup hello, hand-built test payloads)
+# means "no lineage": the hub ingests the payload but books nothing.
+LINEAGE_SLOTS = 3
+
+
+def wire_payload(values, seq, t_compute=None, t_publish=None):
+    """Semantic payload + lineage suffix -> the on-wire array."""
+    import time
+
+    values = np.asarray(values, dtype=np.float64).reshape(-1)
+    now = time.time()
+    out = np.empty(values.shape[0] + LINEAGE_SLOTS)
+    out[:-LINEAGE_SLOTS] = values
+    out[-3] = float(seq)
+    out[-2] = now if t_compute is None else float(t_compute)
+    out[-1] = now if t_publish is None else float(t_publish)
+    return out
+
+
+def split_wire(values):
+    """On-wire array -> (payload view, seq, t_compute, t_publish)."""
+    return (values[:-LINEAGE_SLOTS], float(values[-3]),
+            float(values[-2]), float(values[-1]))
+
 
 class Window:
     """A one-writer many-reader buffer with the write-id protocol."""

@@ -16,7 +16,8 @@ import numpy as np
 
 from .. import obs
 from . import SPOKE_SLEEP_TIME
-from .spcommunicator import SPCommunicator, Window
+from .spcommunicator import (LINEAGE_SLOTS, SPCommunicator, Window,
+                             wire_payload)
 
 
 class ConvergerSpokeType(enum.Enum):
@@ -57,11 +58,24 @@ class Spoke(SPCommunicator):
         self._pulse_interval = float(self.options.get(
             "spoke_pulse_interval", 1.0))
         self._last_put = time.monotonic()
+        # bound-flow lineage (spcommunicator wire_payload): per-spoke
+        # publish counter + the last full wire buffer, re-put verbatim
+        # by heartbeat pulses so a pulse never masquerades as a fresh
+        # publish (same seq, same stamps — only the write-id advances)
+        self._publish_seq = 0
+        self._last_wire = None
 
     # -- wire protocol (ref. spoke.py:59-99) --
-    def spoke_to_hub(self, values):
+    def spoke_to_hub(self, values, t_compute=None):
+        """Publish one payload with its lineage stamp. ``t_compute`` is
+        the wall-clock instant the value was COMPUTED (defaults to now:
+        compute and publish coincide for every current spoke — the slot
+        exists so a spoke that batches results can stamp honestly)."""
+        self._publish_seq += 1
+        self._last_wire = wire_payload(values, self._publish_seq,
+                                       t_compute=t_compute)
         self._last_put = time.monotonic()
-        self.my_window.put(values)
+        self.my_window.put(self._last_wire)
 
     def spoke_from_hub(self):
         """Return (fresh, values). Fresh iff the hub's write-id advanced.
@@ -102,8 +116,11 @@ class Spoke(SPCommunicator):
         return self.hub_window.read_id() == Window.KILL
 
     def local_window_length(self) -> int:
-        # payload_length is the ONE override point for spoke→hub layout
-        return self.payload_length(self.opt.batch.S, self.opt.batch.K)
+        # payload_length is the ONE override point for spoke→hub layout;
+        # every spoke→hub window carries the lineage suffix behind it
+        # (spcommunicator.LINEAGE_SLOTS — the hub strips it on read)
+        return self.payload_length(self.opt.batch.S, self.opt.batch.K) \
+            + LINEAGE_SLOTS
 
     def _init_trace(self, header):
         """Create the live trace CSV when a trace_prefix was given
@@ -167,13 +184,18 @@ class _BoundSpoke(Spoke):
         if time.monotonic() - self._last_put >= self._pulse_interval:
             # direct window put, NOT spoke_to_hub: pulses must stay
             # invisible to publish-count semantics (fault-plan
-            # ``at_update`` triggers count real publishes only)
+            # ``at_update`` triggers count real publishes only, and the
+            # hub's bound-flow accounting keys on the lineage seq).
+            # Re-put the LAST wire buffer verbatim — same seq, same
+            # stamps — or the all-NaN hello when nothing was published
             self._last_put = time.monotonic()
-            self.my_window.put(np.full(
-                self.local_window_length(),
-                np.nan if self.bound is None else self.bound))
+            self.my_window.put(self._last_wire if self._last_wire
+                               is not None
+                               else np.full(self.local_window_length(),
+                                            np.nan))
 
     def update_bound(self, value: float):
+        t_compute = time.time()      # lineage compute stamp (wall clock)
         prev_t = self._trace[-1][0] if self._trace else None
         self.bound = float(value)
         self._trace.append((time.monotonic(), self.bound))
@@ -193,7 +215,7 @@ class _BoundSpoke(Spoke):
         if self._trace_path:
             with open(self._trace_path, "a") as f:
                 f.write(f"{self._trace[-1][0]},{self.bound}\n")
-        self.spoke_to_hub(np.array([self.bound]))
+        self.spoke_to_hub(np.array([self.bound]), t_compute=t_compute)
 
     def write_trace(self, path):
         with open(path, "w") as f:

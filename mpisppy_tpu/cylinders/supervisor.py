@@ -227,8 +227,10 @@ class WheelSupervisor:
         h.last_progress = now
         if self.hub is not None:
             # fresh window pair starts at write-id 0 — reset freshness
-            # so the respawned spoke's hello/bounds are consumed
+            # so the respawned spoke's hello/bounds are consumed; the
+            # bound-flow tracker likewise restarts its lineage seq
             self.hub._spoke_last_ids[i] = 0
+            self.hub.note_spoke_respawn(i, h.gen)
         obs.counter_add("hub.spoke_respawn")
         obs.event("hub.spoke_respawn",
                   {"spoke": i, "kind": self.kinds[i], "gen": h.gen,

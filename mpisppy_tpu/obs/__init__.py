@@ -164,6 +164,14 @@ def counter_value(name) -> float:
     return r.metrics.counter_get(name) if r is not None else 0
 
 
+def histogram_snapshot(name) -> dict | None:
+    """Snapshot of one histogram (None when disabled or never
+    observed) — the hub's bound-flow status reads staleness tails
+    through this."""
+    r = _REC
+    return r.metrics.histogram_get(name) if r is not None else None
+
+
 def flush(nonblocking=False):
     """Persist artifacts. ``nonblocking=True`` is for signal handlers:
     skips any sink whose lock the interrupted frame holds."""

@@ -309,13 +309,160 @@ def fault_summary(run: Run) -> dict:
     return out
 
 
-def invariant_checks(run: Run) -> list:
+def bound_flow_summary(run: Run) -> dict | None:
+    """Per-spoke bound-flow ledger + verdict — the live-plane answer to
+    ROADMAP item 1's diagnostic question ("is the Lagrangian spoke
+    starved, too slow, or having its bounds rejected?"). Assembled from
+    three independent sources so a killed run still renders:
+
+    - hub metrics: ``hub.spoke.produced_writes/.consumed_writes/.lag``
+      gauges, ``hub.spoke.staleness_seconds`` histograms,
+      ``hub.spoke.bounds_accepted/.bounds_rejected`` counters,
+    - spoke ROLE metrics: ``spoke.bound_updates`` (the spoke-side
+      publish truth, summed across respawned generations) and the
+      ``spoke.bound_interval_seconds`` cadence histogram,
+    - the ``hub.iteration`` events' ``flow`` time series (produced vs
+      consumed at every termination check — the silent-starvation
+      signal).
+
+    Verdicts (doc/observability.md documents the thresholds):
+    REJECTED — the hub quarantined at least as many of this spoke's
+    payloads as it accepted; STARVED — publishes advance while hub
+    consumption stays flat (streak in the flow series), or the hub
+    missed at least half of ≥4 publishes (window overwrites), or
+    publishes were never consumed at all; SLOW — the spoke published
+    ≤1 bound across ≥10 hub checks, or its publish cadence p50 is
+    >5x the hub's iteration p50; HEALTHY otherwise. None when the run
+    carries no flow data at all (pre-live-plane artifacts)."""
+    spokes: dict[str, dict] = {}
+    # verdicts need HUB-SIDE lineage evidence (flow gauges/counters/
+    # histograms or the hub.iteration flow series). Spoke-role
+    # counters alone (spoke.bound_updates exists since PR 3) must NOT
+    # suffice: a pre-live-plane dir would otherwise read "published
+    # but never consumed" — a false STARVED on every healthy old run.
+    got_hub_flow = False
+    g, c, hists = run.gauges(), run.counters(), run.histograms()
+    for name, v in g.items():
+        for prefix, key in (("hub.spoke.produced_writes.", "produced"),
+                            ("hub.spoke.consumed_writes.", "consumed"),
+                            ("hub.spoke.lag.", "lag")):
+            if name.startswith(prefix):
+                spokes.setdefault(name[len(prefix):], {})[key] = int(v)
+                got_hub_flow = True
+    for name, v in c.items():
+        for prefix, key in (("hub.spoke.bounds_accepted.", "accepted"),
+                            ("hub.spoke.bounds_rejected.", "rejected")):
+            if name.startswith(prefix):
+                spokes.setdefault(name[len(prefix):], {})[key] = int(v)
+                got_hub_flow = True
+    for name, h in hists.items():
+        pre = "hub.spoke.staleness_seconds."
+        if name.startswith(pre) and isinstance(h, dict):
+            ent = spokes.setdefault(name[len(pre):], {})
+            ent["staleness_p50"] = h.get("p50")
+            ent["staleness_p99"] = h.get("p99")
+            got_hub_flow = True
+    # spoke-side truth from the role artifacts (summed across
+    # respawned generations: role "spoke0-lagrangian-r1" -> "spoke0")
+    for role in run.metrics:
+        if not role.startswith("spoke"):
+            continue
+        label, _, kind = role.partition("-")
+        ent = spokes.setdefault(label, {})
+        if kind:
+            ent.setdefault("kind", kind.split("-")[0])
+        rc = run.counters(role)
+        ent["published"] = ent.get("published", 0) \
+            + int(rc.get("spoke.bound_updates", 0))
+        hh = run.histograms(role).get("spoke.bound_interval_seconds")
+        if isinstance(hh, dict) and hh.get("p50") is not None:
+            ent["publish_interval_p50"] = hh["p50"]
+    # flow time series: longest streak of checks where produced
+    # advanced while consumed stayed flat (the silent-starvation case
+    # neither the faults section nor no_late_retraces can see)
+    it_events = run.of("hub.iteration", role="")
+    series = [e["flow"] for e in it_events
+              if isinstance(e.get("flow"), dict)]
+    if series:
+        got_hub_flow = True
+    streaks: dict[str, int] = {}
+    cur: dict[str, int] = {}
+    prev = None
+    for flow in series:
+        if prev is not None:
+            for label, ent in flow.items():
+                p0 = (prev.get(label) or {}).get("produced", 0)
+                c0 = (prev.get(label) or {}).get("consumed", 0)
+                if ent.get("produced", 0) > p0 \
+                        and ent.get("consumed", 0) == c0:
+                    cur[label] = cur.get(label, 0) + 1
+                    streaks[label] = max(streaks.get(label, 0),
+                                         cur[label])
+                else:
+                    cur[label] = 0
+        prev = flow
+    if series:
+        for label, ent in spokes.items():
+            last = series[-1].get(label) or {}
+            ent.setdefault("produced", int(last.get("produced", 0)))
+            ent.setdefault("consumed", int(last.get("consumed", 0)))
+            ent["starvation_streak"] = streaks.get(label, 0)
+    if not spokes or not got_hub_flow:
+        return None
+    it_hist = hists.get("ph.iteration_seconds") or {}
+    n_checks = len(it_events)
+    for ent in spokes.values():
+        ent["verdict"], ent["why"] = _flow_verdict(ent, it_hist,
+                                                   n_checks)
+    return dict(sorted(spokes.items()))
+
+
+def _flow_verdict(ent, it_hist, n_checks):
+    produced = max(int(ent.get("produced", 0)),
+                   int(ent.get("published", 0)))
+    consumed = int(ent.get("consumed", 0))
+    accepted = int(ent.get("accepted", 0))
+    rejected = int(ent.get("rejected", 0))
+    lag = produced - consumed
+    if rejected and rejected >= max(1, accepted):
+        return "REJECTED", (f"{rejected} payload(s) rejected vs "
+                            f"{accepted} accepted — see the faults "
+                            "section for reasons")
+    if produced and not consumed:
+        return "STARVED", (f"{produced} publish(es) but the hub never "
+                           "consumed one")
+    if ent.get("starvation_streak", 0) >= 3:
+        return "STARVED", (f"publishes advanced across "
+                           f"{ent['starvation_streak']} consecutive hub "
+                           "checks while consumption stayed flat")
+    if produced >= 4 and lag >= (produced + 1) // 2:
+        return "STARVED", (f"hub consumed only {consumed} of {produced} "
+                           "publishes (window overwrote the rest)")
+    if produced <= 1 and n_checks >= 10:
+        return "SLOW", (f"{produced} bound(s) published across "
+                        f"{n_checks} hub checks")
+    it_p50 = it_hist.get("p50")
+    pub_p50 = ent.get("publish_interval_p50")
+    # hub p50 floored at 0.2 s: ms-scale toy hubs out-iterate any
+    # spoke, and sub-second cadence is never the binding diagnosis
+    if it_p50 and pub_p50 and pub_p50 > 5.0 * max(it_p50, 0.2):
+        return "SLOW", (f"publish cadence p50 {pub_p50:.2g}s vs hub "
+                        f"iteration p50 {it_p50:.2g}s")
+    return "HEALTHY", ""
+
+
+_UNSET = object()
+
+
+def invariant_checks(run: Run, bound_flow=_UNSET) -> list:
     """[(name, ok, detail, severity)] — the afterward-checkable
     contracts. severity "fail" renders [FAIL] when violated; "warn"
     renders [WARN] for checks whose violation has benign explanations
     (counter deltas are process-global, so an in-process spoke
     thread's legitimate first compile can land inside a hub
-    iteration's window)."""
+    iteration's window). ``bound_flow`` lets callers that already
+    computed :func:`bound_flow_summary` (render_report, the --json
+    path) pass it in instead of paying its event scans twice."""
     checks = []
     c = run.counters()
     calls = c.get("ph.solve_loop_calls", 0)
@@ -374,6 +521,21 @@ def invariant_checks(run: Run) -> list:
                     f"{f['quarantined']} spoke(s) quarantined, "
                     f"{f['crossed_rejections']} crossed-bound "
                     "rejection(s) — see the faults section"), "warn"))
+    # WARN, not FAIL: the silent-starvation case the faults section
+    # and no_late_retraces both miss — a spoke whose produced write
+    # ids advance while the hub's consumed ids stay flat is wasting
+    # its whole compute budget on bounds nobody reads, yet crashes
+    # nothing and retraces nothing
+    bf = bound_flow_summary(run) if bound_flow is _UNSET else bound_flow
+    if bf is not None:
+        starved = {label: ent for label, ent in bf.items()
+                   if ent.get("verdict") == "STARVED"}
+        checks.append((
+            "no_silent_starvation", not starved,
+            ("all spokes consumed" if not starved else
+             "; ".join(f"{label}: {ent['why']}"
+                       for label, ent in starved.items())
+             + " — see the bound flow section"), "warn"))
     return checks
 
 
@@ -544,8 +706,33 @@ def render_report(run: Run) -> str:
                      f"inner {_fmt(w.get('inner'))})")
     L.append("")
 
+    bf = bound_flow_summary(run)
+    if bf is not None:
+        L.append("== bound flow ==")
+        for label, ent in bf.items():
+            kind = f" [{ent['kind']}]" if ent.get("kind") else ""
+            stal = ""
+            if ent.get("staleness_p50") is not None:
+                stal = (f"  staleness p50 {_fmt(ent['staleness_p50'], 2)}s"
+                        f" p99 {_fmt(ent.get('staleness_p99'), 2)}s")
+            cad = ""
+            if ent.get("publish_interval_p50") is not None:
+                cad = (f"  cadence p50 "
+                       f"{_fmt(ent['publish_interval_p50'], 2)}s")
+            why = f" ({ent['why']})" if ent.get("why") else ""
+            L.append(
+                f"  {label}{kind}: produced "
+                f"{ent.get('produced', ent.get('published', 0))} "
+                f"consumed {ent.get('consumed', 0)} "
+                f"lag {ent.get('lag', 0)}  accepted "
+                f"{ent.get('accepted', 0)} rejected "
+                f"{ent.get('rejected', 0)}{stal}{cad}  -> "
+                f"{ent['verdict']}{why}")
+        L.append("")
+
     L.append("== invariant checks ==")
-    for name, ok, detail, severity in invariant_checks(run):
+    for name, ok, detail, severity in invariant_checks(run,
+                                                       bound_flow=bf):
         tag = "PASS" if ok else severity.upper()
         L.append(f"  [{tag}] {name}: {detail}")
     return "\n".join(L)
@@ -576,8 +763,12 @@ def comparison_metrics(run: Run) -> dict:
     if calls:
         out[("gate_syncs_per_solve_call", "count")] = \
             c.get("ph.gate_syncs", 0) / calls
-        out[("xla_compiles_per_solve_call", "count")] = \
-            c.get("jax.compiles", 0) / calls
+        # ABSOLUTE compile count, not per-solve-call: compiles are
+        # per-process structural cost (cold-start + retraces) while
+        # solve-call counts jitter with async wheel timing, so the
+        # ratio of the two flakes across identical trees. A retrace
+        # regression moves the absolute count directly.
+        out[("xla_compiles_total", "count")] = c.get("jax.compiles", 0)
         # sharded runs (ISSUE 6): collective traffic per solve call and
         # steady-state device_put leakage — a sharded-vs-sharded
         # compare flags a collective-volume or placement regression;
@@ -618,10 +809,18 @@ def kernel_summary(run: Run) -> dict:
     }
 
 
-def compare(a: Run, b: Run, threshold=1.5) -> tuple[str, bool]:
+def compare(a: Run, b: Run, threshold=1.5,
+            abs_floor=_ABS_FLOOR_S) -> tuple[str, bool]:
     """Render the A-vs-B diff; returns (text, passed). Raises
     ValueError on a schema mismatch — two formats must not be
-    numerically compared."""
+    numerically compared.
+
+    ``abs_floor`` (seconds) suppresses time-metric verdicts whose
+    absolute delta is below it: micro-phases (sub-ms per call) ride
+    scheduler noise, so a 3x ratio on 0.5 ms is jitter, not a
+    regression. Same-machine compares keep the tight 1 ms default;
+    cross-machine gates (tools/regression_gate.py) pass a looser
+    floor."""
     if a.schema != b.schema:
         raise ValueError(
             f"schema mismatch: {a.path} is v{a.schema}, {b.path} is "
@@ -630,15 +829,15 @@ def compare(a: Run, b: Run, threshold=1.5) -> tuple[str, bool]:
     ma, mb = comparison_metrics(a), comparison_metrics(b)
     L = [f"== compare ==\nA: {a.path}\nB: {b.path}\n"
          f"time regression threshold: {threshold:.2f}x "
-         f"(abs floor {_ABS_FLOOR_S * 1e3:.0f} ms)"]
+         f"(abs floor {abs_floor * 1e3:.0f} ms)"]
     regressions = []
     for key in sorted(set(ma) & set(mb), key=lambda k: k[0]):
         name, kind = key
         va, vb = ma[key], mb[key]
         ratio = (vb / va) if va > 0 else (math.inf if vb > 0 else 1.0)
         if kind == "time":
-            bad = ratio > threshold and (vb - va) > _ABS_FLOOR_S
-            better = ratio < 1.0 / threshold and (va - vb) > _ABS_FLOOR_S
+            bad = ratio > threshold and (vb - va) > abs_floor
+            better = ratio < 1.0 / threshold and (va - vb) > abs_floor
         else:
             bad = ratio > 1.25 and (vb - va) > 0.5
             better = ratio < 0.8 and (va - vb) > 0.5
@@ -679,6 +878,136 @@ def compare(a: Run, b: Run, threshold=1.5) -> tuple[str, bool]:
     return "\n".join(L), passed
 
 
+# ---------------- watch (the live tail) ----------------
+
+def _rel_age(now, wall):
+    if not isinstance(wall, (int, float)):
+        return "?"
+    return f"{max(0.0, now - wall):.1f}s ago"
+
+
+def render_watch(path) -> tuple[str, bool]:
+    """One refresh frame of ``analyze --watch``: the live.json snapshot
+    the hub atomically rewrites on every termination check, plus the
+    tail of the event streams. Returns (frame, done) — done once a
+    ``run_footer`` has landed (the run is over; the next refresh would
+    show the same thing forever)."""
+    import time
+
+    now = time.time()
+    L = [f"== live wheel == {path}"]
+    live = None
+    lp = os.path.join(path, "live.json")
+    if os.path.exists(lp):
+        try:
+            with open(lp, encoding="utf-8") as fh:
+                live = json.load(fh)
+        except ValueError:
+            live = None     # racing the atomic replace; next tick wins
+    if live is not None:
+        L.append(
+            f"run {live.get('run_id')}  iter {live.get('iter')}  "
+            f"updated {_rel_age(now, live.get('wall_time_unix'))}"
+            + ("  [WATCHDOG FIRED]" if live.get("watchdog_fired")
+               else ""))
+        L.append(
+            f"outer {_fmt(live.get('outer'), 8)} "
+            f"[{live.get('ob_char', ' ')}]  "
+            f"inner {_fmt(live.get('inner'), 8)} "
+            f"[{live.get('ib_char', ' ')}]  "
+            f"rel gap {_fmt(live.get('rel_gap'))}  "
+            f"elapsed {_fmt(live.get('elapsed_seconds'), 4)}s")
+        ph = live.get("phases")
+        if ph:
+            L.append(f"phases [{ph.get('mode')}] occupancy "
+                     f"{_fmt(ph.get('occupancy'), 3)}  s/call "
+                     + "  ".join(f"{k} {_fmt(v, 3)}" for k, v in
+                                 (ph.get("seconds_per_call")
+                                  or {}).items()))
+        for sp in live.get("spokes", ()):
+            flags = []
+            if sp.get("alive") is False:
+                flags.append("DEAD")
+            if sp.get("crashes"):
+                flags.append(f"crashes {sp['crashes']}")
+            stal = sp.get("staleness_last_seconds")
+            L.append(
+                f"  spoke{sp.get('index')} "
+                f"[{sp.get('kind') or sp.get('spoke', '?')}] "
+                f"{sp.get('state', '?')} gen {sp.get('gen', 0)}  "
+                f"produced {sp.get('produced', 0)} consumed "
+                f"{sp.get('consumed', 0)} lag {sp.get('lag', 0)}  "
+                f"accepted {sp.get('accepted', 0)} rejected "
+                f"{sp.get('rejected', 0)}"
+                + (f"  staleness {_fmt(stal, 2)}s"
+                   if stal is not None else "")
+                + ("  " + " ".join(flags) if flags else ""))
+    else:
+        L.append("(no live.json yet — hub has not reached a "
+                 "termination check, or the run predates the live "
+                 "plane)")
+    # event tail across every role stream, newest last
+    tail = []
+    done = False
+    for f in glob.glob(os.path.join(path, "events*.jsonl")):
+        role = _role_of(f, "events", ".jsonl")
+        try:
+            # bounded tail read: the hub stream grows every termination
+            # check, and --watch re-renders every ~2 s — reading whole
+            # multi-hour files each frame would peg IO on the machine
+            # hosting the run this view is meant to observe passively
+            with open(f, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - 65536))
+                chunk = fh.read().decode("utf-8", "replace")
+            lines = chunk.splitlines()[-40:]
+        except OSError:
+            continue
+        for ln in lines:
+            try:
+                e = json.loads(ln)
+            except ValueError:
+                continue
+            if e.get("type") == "run_footer" and role == "":
+                done = True
+            tail.append((e.get("t", 0.0), role, e))
+    tail.sort(key=lambda t: t[0])
+    L.append("recent events:")
+    for t, role, e in tail[-8:]:
+        fields = " ".join(
+            f"{k}={_fmt(v) if isinstance(v, float) else v}"
+            for k, v in e.items()
+            if k not in ("t", "type", "_role", "config", "metrics")
+            and not isinstance(v, (dict, list)))
+        L.append(f"  [{role or 'hub':>18}] {e.get('type')} "
+                 f"{fields[:120]}")
+    if done:
+        L.append("(run complete — footer landed; watch exiting. "
+                 "Run `analyze` on the dir for the full report.)")
+    return "\n".join(L), done
+
+
+def watch(path, interval=2.0, refreshes=None) -> int:
+    """Refreshing terminal view of a live run directory: tail
+    live.json + events.jsonl until the run footer lands (or
+    ``refreshes`` frames for tests / one-shot peeks)."""
+    import time
+
+    n = 0
+    while True:
+        frame, done = render_watch(path)
+        # ANSI clear + home; falls out harmlessly on dumb terminals
+        print("\x1b[2J\x1b[H" + frame, flush=True)
+        n += 1
+        if done or (refreshes is not None and n >= refreshes):
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 # ---------------- CLI ----------------
 
 def make_parser():
@@ -691,8 +1020,24 @@ def make_parser():
                         "--compare")
     p.add_argument("--compare", action="store_true",
                    help="diff two runs: analyze --compare A B")
+    p.add_argument("--watch", action="store_true",
+                   help="live mode: refreshing terminal view tailing "
+                        "the dir's live.json + events.jsonl while the "
+                        "run iterates (exits when the run footer "
+                        "lands)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch refresh seconds (default 2)")
+    p.add_argument("--refreshes", type=int, default=None,
+                   help="--watch: stop after N frames (default: until "
+                        "the run ends)")
     p.add_argument("--threshold", type=float, default=1.5,
                    help="time-metric regression ratio (default 1.5)")
+    p.add_argument("--abs-floor-ms", type=float,
+                   default=_ABS_FLOOR_S * 1e3,
+                   help="ignore time-metric deltas below this many ms "
+                        "per call/iteration (default 1 — raise for "
+                        "cross-machine compares where micro-phase "
+                        "timings are scheduler noise)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     return p
@@ -701,6 +1046,12 @@ def make_parser():
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
     try:
+        if args.watch:
+            if len(args.dirs) != 1:
+                print("analyze --watch needs exactly one telemetry dir")
+                return 2
+            return watch(args.dirs[0], interval=args.interval,
+                         refreshes=args.refreshes)
         if args.compare:
             if len(args.dirs) != 2:
                 print("analyze --compare needs exactly two telemetry "
@@ -708,7 +1059,9 @@ def main(argv=None) -> int:
                 return 2
             a, b = load_run(args.dirs[0]), load_run(args.dirs[1])
             try:
-                text, passed = compare(a, b, threshold=args.threshold)
+                text, passed = compare(
+                    a, b, threshold=args.threshold,
+                    abs_floor=args.abs_floor_ms / 1e3)
             except ValueError as e:
                 print(f"analyze: {e}")
                 return 2
@@ -740,9 +1093,11 @@ def main(argv=None) -> int:
                             if k != "entries"},
                 "sharding": sharding_summary(run),
                 "faults": fault_summary(run),
+                "bound_flow": (bf := bound_flow_summary(run)),
                 "invariants": [
                     {"name": n, "ok": ok, "detail": d, "severity": sv}
-                    for n, ok, d, sv in invariant_checks(run)],
+                    for n, ok, d, sv in invariant_checks(
+                        run, bound_flow=bf)],
             }))
         else:
             print(render_report(run))

@@ -134,6 +134,14 @@ class MetricsRegistry:
         with self._lock:
             return self.counters.get(name, 0)
 
+    def histogram_get(self, name):
+        """Snapshot of ONE histogram (None when it never observed) —
+        the live status surface reads single tails without paying for
+        a full registry snapshot."""
+        with self._lock:
+            h = self.histograms.get(name)
+            return h.snapshot() if h is not None else None
+
     def snapshot(self, nonblocking=False):
         """JSON-ready snapshot of every metric. With ``nonblocking``
         (signal-handler context: the interrupted frame may HOLD the

@@ -193,8 +193,10 @@ class FaultInjector:
         orig_put = spoke.spoke_to_hub
         orig_poll = spoke.got_kill_signal
 
-        def _put(values):
-            return orig_put(self.on_publish(values))
+        def _put(values, **kw):
+            # kwargs (lineage t_compute) pass through untouched: faults
+            # corrupt the semantic payload, never the lineage stamps
+            return orig_put(self.on_publish(values), **kw)
 
         def _poll():
             self.on_poll()

@@ -166,6 +166,15 @@ class RunConfig:
     # writes events.jsonl + trace.json + metrics.json there and the
     # config snapshot lands in the stream's run_header
     telemetry_dir: str | None = None
+    # ---- live plane (obs/live.py, doc/observability.md) ----
+    # in-run status server owned by the hub process: /metrics
+    # (Prometheus text exposition of the Recorder registry) + /status
+    # (JSON wheel state). None = off; 0 = bind an ephemeral port.
+    # live.json rides telemetry_dir and needs no port. The bind host
+    # defaults to LOOPBACK — the endpoints serve full run state with
+    # no auth; "0.0.0.0" is the explicit opt-in for remote scrapers.
+    status_port: int | None = None
+    status_host: str = "127.0.0.1"
     # ---- robustness (doc/fault_tolerance.md) ----
     # wheel watchdog: terminate a wheel that outlives this many seconds
     # (telemetry flushed, partial bounds reported); None = no deadline
@@ -211,6 +220,10 @@ class RunConfig:
             raise ValueError("abs_gap must be >= 0")
         if self.wheel_deadline is not None and self.wheel_deadline <= 0:
             raise ValueError("wheel_deadline must be positive")
+        if self.status_port is not None \
+                and not (0 <= int(self.status_port) <= 65535):
+            raise ValueError("status_port must be in [0, 65535] "
+                             "(0 = ephemeral) or None (off)")
         if self.spoke_sleep_time is not None and self.spoke_sleep_time < 0:
             raise ValueError("spoke_sleep_time must be >= 0")
         if self.spoke_ready_timeout <= 0 or self.join_timeout <= 0:

@@ -76,8 +76,12 @@ class SpokeProxy:
         # the spoke class owns its payload layout (Spoke.payload_length:
         # 1 for bound spokes, 2 for the dual-typed EF-MIP spoke,
         # S*(1+K) for the cut spoke) — sizing it here too would let the
-        # hub-side and child-side windows drift apart
-        return self._spoke_cls.payload_length(self._S, self._K)
+        # hub-side and child-side windows drift apart. Every spoke→hub
+        # window carries the bound-flow lineage suffix
+        # (spcommunicator.LINEAGE_SLOTS).
+        from ..cylinders.spcommunicator import LINEAGE_SLOTS
+        return self._spoke_cls.payload_length(self._S, self._K) \
+            + LINEAGE_SLOTS
 
 
 def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
@@ -331,6 +335,7 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
     ctx = mp.get_context("spawn")
     proxies, procs, owned = [], [], []
     supervisor = None
+    hub = None
     try:
         proxies, procs, owned = spawn_spoke_processes(cfg, run_id, ctx,
                                                       S, K, f32)
@@ -394,7 +399,11 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
         # startup-failure cleanup: a hello timeout (or any raise before
         # the normal terminate/join path) must not leak live children —
         # daemon processes would otherwise linger, polling windows the
-        # finally below unlinks, until interpreter exit
+        # finally below unlinks, until interpreter exit. The status
+        # server's port is released the same way (the normal path stops
+        # it in hub_finalize).
+        if hub is not None:
+            hub.shutdown_live()
         if supervisor is not None:
             supervisor.shutdown()
         for p in procs:

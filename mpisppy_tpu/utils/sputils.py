@@ -163,6 +163,12 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None,
 
     try:
         hub.main()                      # ref. sputils.py:115 spcomm.main()
+    except BaseException:
+        # exceptional exit skips hub_finalize — release the status
+        # server's port here (normal path: hub_finalize stops it after
+        # serving the final state; shutdown_live is idempotent)
+        hub.shutdown_live()
+        raise
     finally:
         hub.send_terminate()            # ref. sputils.py:117 / hub.py:356
     # two-phase join: spokes poll the kill signal between candidate

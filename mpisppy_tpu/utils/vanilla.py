@@ -87,6 +87,10 @@ def hub_dict(cfg: RunConfig, batch=None):
         hub_kwargs["options"]["abs_gap"] = cfg.abs_gap
     if cfg.wheel_deadline is not None:
         hub_kwargs["options"]["wheel_deadline"] = cfg.wheel_deadline
+    if cfg.status_port is not None:
+        # the hub process owns the live status server (obs/live.py)
+        hub_kwargs["options"]["status_port"] = cfg.status_port
+        hub_kwargs["options"]["status_host"] = cfg.status_host
     if "crossed_bound_tol" in cfg.supervisor:
         hub_kwargs["options"]["crossed_bound_tol"] = \
             cfg.supervisor["crossed_bound_tol"]

@@ -168,10 +168,12 @@ def test_base_receive_does_not_consume_cut_windows():
     hub.setup_hub()
     ci = next(iter(hub.cut_spoke_indices))
 
-    # simulate a cut payload landing in the spoke's window
+    # simulate a cut payload landing in the spoke's window (through
+    # the real publish path, so it carries the lineage suffix the
+    # hub's _consume_window strips)
     S, K = cph.batch.S, cph.batch.K
     payload = np.zeros(S * (1 + K))
-    spoke.my_window.put(payload)
+    spoke.spoke_to_hub(payload)
 
     # the BASE bound loop must leave the cut window unread...
     super(CrossScenarioHub, hub).receive_bounds()

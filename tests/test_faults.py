@@ -31,7 +31,8 @@ import pytest
 
 from mpisppy_tpu import obs
 from mpisppy_tpu.cylinders.hub import Hub
-from mpisppy_tpu.cylinders.spcommunicator import Window
+from mpisppy_tpu.cylinders.spcommunicator import (LINEAGE_SLOTS, Window,
+                                                  wire_payload)
 from mpisppy_tpu.cylinders.spoke import ConvergerSpokeType
 from mpisppy_tpu.cylinders import supervisor as sup_mod
 from mpisppy_tpu.cylinders.supervisor import WheelSupervisor
@@ -52,14 +53,22 @@ class _Opt:
 
 
 class _FakeSpoke:
-    """Proxy-shaped spoke: classification surface + window pair."""
+    """Proxy-shaped spoke: classification surface + window pair.
+    ``publish`` stamps the bound-flow lineage suffix exactly like
+    ``Spoke.spoke_to_hub`` (the on-wire format is payload + 3 lineage
+    doubles — spcommunicator.wire_payload)."""
 
     def __init__(self, types=(ConvergerSpokeType.OUTER_BOUND,),
                  char="O", length=1):
         self.converger_spoke_types = types
         self.converger_spoke_char = char
-        self.my_window = Window(length)
+        self.my_window = Window(length + LINEAGE_SLOTS)
         self.hub_window = Window(1)
+        self._seq = 0
+
+    def publish(self, values):
+        self._seq += 1
+        self.my_window.put(wire_payload(values, self._seq))
 
 
 class _FakeProc:
@@ -213,22 +222,22 @@ def test_receive_bounds_quarantines_inf_and_crossed(mem_obs):
     hub = Hub(_Opt(), spokes=[outer, inner])
     hub.classify_spokes()
     # startup hello: all-NaN consumed silently
-    outer.my_window.put(np.array([np.nan]))
+    outer.my_window.put(np.full(1 + LINEAGE_SLOTS, np.nan))
     hub.receive_bounds()
     assert hub.BestOuterBound == -math.inf
     assert obs.counter_value("hub.bound_rejected") == 0
     # +inf payload: rejected, gap machinery untouched
-    outer.my_window.put(np.array([np.inf]))
+    outer.publish(np.array([np.inf]))
     hub.receive_bounds()
     assert hub.BestOuterBound == -math.inf
     # legit inner, then a crossed outer (above inner + tol): rejected
-    inner.my_window.put(np.array([-100.0]))
+    inner.publish(np.array([-100.0]))
     hub.receive_bounds()
-    outer.my_window.put(np.array([-99.5]))
+    outer.publish(np.array([-99.5]))
     hub.receive_bounds()
     assert hub.BestOuterBound == -math.inf
     # a legit outer lands fine
-    outer.my_window.put(np.array([-100.8]))
+    outer.publish(np.array([-100.8]))
     hub.receive_bounds()
     assert hub.BestOuterBound == -100.8
     assert obs.counter_value("hub.bound_rejected") == 2
@@ -238,7 +247,7 @@ def test_receive_bounds_quarantines_inf_and_crossed(mem_obs):
     assert all(e["spoke"] == 0 for e in evs)
     # and noise-level crossings (2e-6 rel, the healthy-wheel case) are
     # NOT flagged as corruption
-    outer.my_window.put(np.array([-100.0 + 2e-6 * 100.0]))
+    outer.publish(np.array([-100.0 + 2e-6 * 100.0]))
     hub.receive_bounds()
     assert hub.BestOuterBound > -100.001
     assert obs.counter_value("hub.bound_crossed") == 1
@@ -254,14 +263,14 @@ def test_finite_garbage_rejected_before_it_can_poison(mem_obs):
     outer = _FakeSpoke((ConvergerSpokeType.OUTER_BOUND,), "O")
     hub = Hub(_Opt(), spokes=[inner, outer])
     hub.classify_spokes()
-    inner.my_window.put(np.array([-1e30]))      # garbage "incumbent"
+    inner.publish(np.array([-1e30]))      # garbage "incumbent"
     hub.receive_bounds()
     assert hub.BestInnerBound == math.inf       # rejected, not installed
     evs = _events(mem_obs, "hub.bound_rejected")
     assert evs[-1]["reason"] == "implausible"
     # legitimate traffic flows unharmed afterwards
-    inner.my_window.put(np.array([-100.0]))
-    outer.my_window.put(np.array([-110.0]))
+    inner.publish(np.array([-100.0]))
+    outer.publish(np.array([-110.0]))
     hub.receive_bounds()
     assert hub.BestInnerBound == -100.0 and hub.BestOuterBound == -110.0
     assert obs.counter_value("hub.bound_crossed") == 0
@@ -280,16 +289,16 @@ def test_crossed_rejection_does_not_blame_the_sender(mem_obs):
                           options={"max_rejections": 2,
                                    "poll_interval": 0.0})
     sup.attach(hub)
-    inner.my_window.put(np.array([-100.0]))
+    inner.publish(np.array([-100.0]))
     hub.receive_bounds()
     for _ in range(3):                      # crossed payloads galore
-        outer.my_window.put(np.array([-99.0]))
+        outer.publish(np.array([-99.0]))
         hub.receive_bounds()
     assert obs.counter_value("hub.bound_crossed") == 3
     assert sup.state(0) == sup_mod.RUNNING  # sender NOT quarantined
     # unambiguous garbage still counts toward quarantine
     for _ in range(2):
-        outer.my_window.put(np.array([np.inf]))
+        outer.publish(np.array([np.inf]))
         hub.receive_bounds()
     assert sup.state(0) == sup_mod.QUARANTINED
 
@@ -299,7 +308,7 @@ def test_dual_window_validates_both_sides(mem_obs):
                      ConvergerSpokeType.INNER_BOUND), "E", length=2)
     hub = Hub(_Opt(), spokes=[ef])
     hub.classify_spokes()
-    ef.my_window.put(np.array([np.inf, -100.0]))
+    ef.publish(np.array([np.inf, -100.0]))
     hub.receive_bounds()
     assert hub.BestOuterBound == -math.inf      # inf side rejected
     assert hub.BestInnerBound == -100.0         # finite side installed
@@ -315,7 +324,7 @@ def test_rejections_quarantine_the_spoke(mem_obs):
                                    "poll_interval": 0.0})
     sup.attach(hub)
     for _ in range(3):
-        outer.my_window.put(np.array([np.inf]))
+        outer.publish(np.array([np.inf]))
         hub.receive_bounds()
     assert sup.state(0) == sup_mod.QUARANTINED
     assert 0 not in hub.outer_bound_spoke_indices
@@ -391,7 +400,7 @@ def test_supervisor_heartbeat_stall_detection(mem_obs):
     hub, sup, spokes, procs, spawned = _make_supervised(
         mem_obs, n=1, heartbeat_timeout=0.02)
     sup.poll()                      # baseline
-    spokes[0].my_window.put(np.array([1.0]))
+    spokes[0].publish(np.array([1.0]))
     sup.poll()                      # progress observed
     time.sleep(0.05)
     sup.poll()                      # frozen past the timeout
@@ -518,6 +527,24 @@ def test_sigkill_spoke_respawn_wheel(tmp_path):
     # green (downs+respawns degrade, but nothing was quarantined)
     rc = analyze.main([tdir])
     assert rc == 0
+    # the bound-flow section renders a per-spoke verdict on the
+    # FAULT-INJECTED wheel too (ISSUE 8 acceptance): the respawned
+    # Lagrangian published and was consumed -> its bounds closed the
+    # gap, so neither spoke may read REJECTED
+    r = analyze.load_run(tdir)
+    bf = analyze.bound_flow_summary(r)
+    assert bf is not None and len(bf) >= 2
+    rep = analyze.render_report(r)
+    assert "== bound flow ==" in rep
+    for label, ent in bf.items():
+        assert ent["verdict"] in ("HEALTHY", "SLOW", "STARVED",
+                                  "REJECTED"), ent
+    lag = bf.get("spoke0", {})
+    assert lag.get("consumed", 0) >= 1      # respawned gen was consumed
+    assert lag.get("verdict") != "REJECTED"
+    # spoke-side publish truth was merged across generations (the
+    # gen-1 role artifacts carry the respawned incarnation's updates)
+    assert lag.get("published", 0) >= 1
 
 
 @pytest.mark.slow

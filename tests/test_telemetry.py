@@ -56,6 +56,9 @@ def telemetry(tmp_path):
 class _DummyOpt:
     options = {}
 
+    class batch:        # window sizing (Spoke.local_window_length)
+        S, K = 1, 1
+
 
 # ---------------- core registry / stream / trace ----------------
 
@@ -404,7 +407,7 @@ def test_hub_bound_events_monotonic_with_wall_anchor(telemetry):
 def test_spoke_bound_update_emits_event(telemetry):
     rec, _ = telemetry
     sp = OuterBoundSpoke(_DummyOpt())
-    sp.my_window = Window(1)
+    sp.my_window = Window(sp.local_window_length())
     sp.update_bound(-42.5)
     ev = [e for e in rec.events.tail if e["type"] == "spoke.bound"]
     assert ev and ev[0]["value"] == -42.5

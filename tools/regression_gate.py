@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Tier-1 perf regression gate: farmer bench vs committed golden run.
+
+The ISSUE 8 CI satellite: perf regressions used to surface only on the
+driver (a BENCH re-run on real hardware, days later). This gate runs
+the SMALL farmer bench wheel with telemetry on and diffs it against a
+COMMITTED golden telemetry directory with ``analyze --compare``, so a
+per-iteration time or counter regression (gate syncs per solve call,
+total compile count, phase s/call) fails in-repo, at tier-1 speed.
+
+Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
+3 REGRESSION.
+
+Usage:
+  python tools/regression_gate.py                 # gate against golden
+  python tools/regression_gate.py --threshold 2   # stricter time gate
+  python tools/regression_gate.py --update-golden # re-baseline (after
+                                                  # a LEGITIMATE change
+                                                  # to compile counts /
+                                                  # phase anatomy)
+
+The default time gate is deliberately loose (3x ratio over a 20 ms
+absolute floor): the golden dir was recorded on one machine and CI
+runs on another — the gate exists to catch structural regressions
+(a 2x phase blowup, extra gate syncs, a retrace per iteration), not
+±20% machine jitter or scheduler noise on the bench's sub-ms
+micro-phases. Count metrics use analyze's fixed 1.25x gate, which IS
+machine-independent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "ci", "golden_farmer_telemetry")
+
+# the golden run's exact recipe — regeneration and the fresh side must
+# match, or the compare diffs configuration instead of code
+BENCH_ARGS = ["farmer", "--num-scens", "3", "--max-iterations", "5",
+              "--convthresh", "-1", "--subproblem-max-iter", "1500",
+              "--with-lagrangian", "--with-xhatshuffle",
+              "--rel-gap", "1e-6"]
+
+
+def run_bench(out_dir: str) -> int:
+    """One small farmer wheel with telemetry into ``out_dir`` — a
+    subprocess so the gate script itself never imports jax and every
+    invocation pays the same cold-start shape the golden did."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)   # ours, explicitly
+    cmd = [sys.executable, "-m", "mpisppy_tpu", *BENCH_ARGS,
+           "--telemetry-dir", out_dir]
+    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=600)
+    return r.returncode
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="tier-1 perf regression gate "
+                    "(bench + analyze --compare vs committed golden)")
+    p.add_argument("--golden", default=GOLDEN,
+                   help=f"golden telemetry dir (default {GOLDEN})")
+    p.add_argument("--threshold", type=float, default=3.0,
+                   help="time-metric regression ratio passed to "
+                        "analyze --compare (default 3.0 — loose on "
+                        "purpose, cross-machine)")
+    p.add_argument("--abs-floor-ms", type=float, default=20.0,
+                   help="ignore time deltas below this many ms per "
+                        "call (default 20 — the bench's real phases "
+                        "run 0.1-2 s/call, so a structural 2x blowup "
+                        "still clears it, while its sub-ms "
+                        "micro-phases ride scheduler noise that a "
+                        "ratio gate alone would flag)")
+    p.add_argument("--keep", default=None,
+                   help="keep the fresh telemetry dir here (default: "
+                        "a deleted tempdir)")
+    p.add_argument("--update-golden", action="store_true",
+                   help="re-record the golden dir instead of gating "
+                        "(commit the result)")
+    args = p.parse_args(argv)
+
+    if args.update_golden:
+        os.makedirs(os.path.dirname(args.golden), exist_ok=True)
+        shutil.rmtree(args.golden, ignore_errors=True)
+        rc = run_bench(args.golden)
+        if rc != 0:
+            print(f"regression_gate: bench run failed (rc {rc})")
+            return rc or 1
+        # live.json is a moving in-run snapshot, not a comparison
+        # artifact — keep the committed golden minimal
+        lj = os.path.join(args.golden, "live.json")
+        if os.path.exists(lj):
+            os.remove(lj)
+        print(f"regression_gate: golden re-recorded at {args.golden} "
+              "— commit it")
+        return 0
+
+    if not os.path.isdir(args.golden):
+        print(f"regression_gate: no golden dir at {args.golden} — "
+              "record one with --update-golden and commit it")
+        return 2
+
+    fresh = args.keep or tempfile.mkdtemp(prefix="regression_gate_")
+    try:
+        rc = run_bench(fresh)
+        if rc != 0:
+            print(f"regression_gate: bench run failed (rc {rc})")
+            return rc or 1
+        # analyze is jax-free — import it here, after the bench
+        # subprocess did the heavy lifting
+        sys.path.insert(0, REPO)
+        from mpisppy_tpu.obs.analyze import main as analyze_main
+        rc = analyze_main(["--compare", args.golden, fresh,
+                           "--threshold", str(args.threshold),
+                           "--abs-floor-ms", str(args.abs_floor_ms)])
+        if rc == 3:
+            print("regression_gate: REGRESSION vs committed golden "
+                  f"({args.golden}). If the change is intentional "
+                  "(new compile, reshaped phases), re-baseline with "
+                  "--update-golden and commit the new golden dir.")
+        return rc
+    finally:
+        if args.keep is None:
+            shutil.rmtree(fresh, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
