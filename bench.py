@@ -399,7 +399,8 @@ _XHAT_ORACLE = {
     "xhat_oracle_gap": 5e-3,
 }
 
-_ACTIVE_WHEEL = {"hub": None, "t0": None, "prefix": None, "baseline": 0.0}
+_ACTIVE_WHEEL = {"hub": None, "t0": None, "prefix": None, "baseline": 0.0,
+                 "incumbent_mode": None}
 _KILLED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_partial_killed.json")
 
@@ -418,7 +419,8 @@ def _flush_active_wheel(signum=None, frame=None):
                          _ACTIVE_WHEEL["t0"], time.perf_counter(),
                          _ACTIVE_WHEEL["baseline"],
                          note="KILLED mid-spin (driver timeout); marks "
-                              "crossed before the kill are real", rel=None)
+                              "crossed before the kill are real", rel=None,
+                         in_signal=True)
         try:
             with open(_KILLED_PATH + ".tmp", "w") as f:
                 json.dump(rows, f, indent=1)
@@ -436,10 +438,15 @@ def _flush_active_wheel(signum=None, frame=None):
         sys.exit(124)
 
 
-def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
+def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
+              in_signal=False):
     """Build (don't emit) the gap metric rows for one wheel — shared by
     the normal emit path and the SIGTERM flush, which must not touch
-    the partials file (see _flush_active_wheel)."""
+    the partials file (see _flush_active_wheel). ``in_signal``: the
+    SIGTERM path skips the incumbent counter read below — the
+    interrupted main-thread frame may hold the metrics registry lock,
+    and a blocking snapshot there would deadlock the kill path
+    (bound_flow_status is separately lock-guarded for exactly this)."""
     marks = hub.gap_mark_times
     tail = "" if rel is None else f"final gap {100 * rel:.3f}%, "
     rows = []
@@ -486,6 +493,27 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
             rows[0]["bound_flow"] = hub.bound_flow_status()
         except Exception:
             pass    # a kill-path flush must never die on diagnostics
+    # device incumbent-pool anatomy (ISSUE 9): mode, pool shape, round
+    # and improvement counts of the timed window, so the gap row says
+    # whether the inner bound came from the device pool or the host
+    # oracle (the dive spoke runs in-process, so the counters are in
+    # this process's registry)
+    if rows and not in_signal:
+        try:
+            ctr = obs.counters_snapshot()
+            rnds = int(ctr.get("incumbent.rounds", 0))
+            if rnds:
+                rows[0]["incumbent"] = {
+                    "mode": _ACTIVE_WHEEL.get("incumbent_mode"),
+                    "pool_size":
+                        int(ctr.get("incumbent.candidates_evaluated",
+                                    0)) // rnds,
+                    "rounds": rnds,
+                    "improvements":
+                        int(ctr.get("incumbent.improvements", 0)),
+                }
+        except Exception:
+            pass
     return rows
 
 
@@ -496,7 +524,7 @@ def _emit_gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel):
 
 def _wheel(batch, lag_device_bound=False, hub_extra=None, lag_extra=None,
            xhat_extra=None, max_iterations=60, rel_gap=0.004, chunk=128,
-           base_opts=None):
+           base_opts=None, dive_extra=None):
     """Hub/spoke dicts for the reference-scale device wheel: df32 PH
     hub + Lagrangian outer spoke + incumbent spoke. rel_gap defaults
     BELOW the 0.005 gap mark so the halfpct metric is reachable
@@ -505,10 +533,17 @@ def _wheel(batch, lag_device_bound=False, hub_extra=None, lag_extra=None,
     ``lag_device_bound``: outer bound from the DEVICE dual certificate
     (prox-off solve duals, core/ph Ebound) instead of the exact host
     LP oracle — the framework's own bound machinery end-to-end
-    (VERDICT r4 #4)."""
+    (VERDICT r4 #4).
+
+    ``dive_extra`` (dict, None = no dive spoke): add the device-side
+    batched incumbent spoke (cylinders/xhat_bounders.DiveInnerBound,
+    ISSUE 9) beside the oracle incumbent spoke — candidate pools as
+    ordinary chunks of the engine's dispatch, zero host subprocesses;
+    the gap row's ``incumbent`` block records its round anatomy."""
     from mpisppy_tpu.cylinders.hub import PHHub
     from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
-    from mpisppy_tpu.cylinders.xhat_bounders import XhatShuffleInnerBound
+    from mpisppy_tpu.cylinders.xhat_bounders import (DiveInnerBound,
+                                                     XhatShuffleInnerBound)
     from mpisppy_tpu.core.ph import PH, PHBase
 
     S = batch.S
@@ -548,6 +583,14 @@ def _wheel(batch, lag_device_bound=False, hub_extra=None, lag_extra=None,
          "opt_kwargs": {"batch": batch, "options": xhat_opts,
                         "dtype": jax.numpy.float64}},
     ]
+    if dive_extra is not None:
+        dive_opts = dict(base, xhat_pin_vars=["u"], **chunk_kw)
+        dive_opts.update(dive_extra)
+        spoke_dicts.append(
+            {"spoke_class": DiveInnerBound, "spoke_kwargs": {},
+             "opt_class": PHBase,
+             "opt_kwargs": {"batch": batch, "options": dive_opts,
+                            "dtype": jax.numpy.float64}})
     return hub_dict, spoke_dicts
 
 
@@ -577,7 +620,8 @@ def _warm_gap_programs(batch, tag):
 
 def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
                    note, rel_gap=0.004, lag_device_bound=False,
-                   xhat_extra=None, lag_extra=None, warm=True):
+                   xhat_extra=None, lag_extra=None, warm=True,
+                   dive_extra=None):
     from mpisppy_tpu.utils.sputils import spin_the_wheel
 
     if warm:
@@ -585,13 +629,17 @@ def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
     _progress(f"{metric_prefix}: building wheel (S={batch.S})")
     hd, sds = _wheel(batch, lag_device_bound=lag_device_bound,
                      max_iterations=max_iterations, rel_gap=rel_gap,
-                     xhat_extra=xhat_extra, lag_extra=lag_extra)
+                     xhat_extra=xhat_extra, lag_extra=lag_extra,
+                     dive_extra=dive_extra)
     _progress(f"{metric_prefix}: spinning")
     t0 = time.perf_counter()
+    inc_mode = None if dive_extra is None \
+        else dive_extra.get("incumbent_mode", "device")
     try:
         res = spin_the_wheel(hd, sds, register_hub=lambda hub: (
             _ACTIVE_WHEEL.update(hub=hub, t0=t0, prefix=metric_prefix,
-                                 baseline=baseline_s)))
+                                 baseline=baseline_s,
+                                 incumbent_mode=inc_mode)))
     finally:
         # a failed wheel must deregister too, or a later-phase SIGTERM
         # would flush fabricated rows for the dead wheel
@@ -673,6 +721,20 @@ def bench_uc1024_gap():
         # at >= 0.3) is the cheap shot at a tighter inner bound
         xhat_extra=dict(_XHAT_ORACLE, xhat_min_interval=60.0,
                         xhat_consensus_candidates=True),
+        # the ISSUE 9 device incumbent engine rides beside the oracle
+        # spoke: a SMALL pool (each pool row multiplies the scenario
+        # work of one prox-off chunk pass, so P=10 ≈ 10 extra chunked
+        # solves per round) rate-limited to ~2 rounds in the wheel
+        # budget. The gap row's ``incumbent`` block + bound_flow ledger
+        # record which source produced the winning inner bound — the
+        # r05 anatomy question this PR exists to answer. Unlike the
+        # retired per-scenario dive source (705 s, 0/128 feasible at
+        # this scale, VERDICT r4 #5), the pool FIXES its binaries and
+        # only re-solves the continuous recourse, and its max-commit
+        # anchor row is feasible by construction.
+        dive_extra=dict(incumbent_mode="device", xhat_min_interval=120.0,
+                        incumbent_pool_thresholds=(0.3, 0.5),
+                        incumbent_pool_flips=2, incumbent_pool_random=2),
         warm=False,   # bench_1024 just ran the same programs
         note="the north-star scale (ref. paperruns/larger_uc/quartz/"
              "1000scen_fw: SLURM -N 256, srun -n 4000 ranks of "

@@ -26,7 +26,8 @@ import json
 import sys
 
 from .utils.config import (AlgoConfig, RunConfig, SpokeConfig, KNOWN_MODELS,
-                           KNOWN_SPOKES, KNOWN_HUBS, KERNEL_MODES)
+                           KNOWN_SPOKES, KNOWN_HUBS, KERNEL_MODES,
+                           INCUMBENT_MODES)
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -65,6 +66,16 @@ def make_parser() -> argparse.ArgumentParser:
     for kind in KNOWN_SPOKES:
         p.add_argument(f"--with-{kind.replace('_', '-')}",
                        action="store_true", dest=f"with_{kind}")
+    p.add_argument("--incumbent-mode", choices=INCUMBENT_MODES,
+                   default=None,
+                   help="incumbent source policy for the inner-bound "
+                        "spokes (doc/incumbents.md): 'device' = batched "
+                        "on-device candidate pools/dives only (zero "
+                        "host solver subprocesses), 'oracle' = "
+                        "host-oracle sources only, 'auto' = device "
+                        "with the oracle as opt-in fallback/polish. "
+                        "Default: each spoke's own default (--with-dive "
+                        "defaults to device)")
     # EF path (ref. examples/farmer/farmer_ef.py)
     p.add_argument("--EF", action="store_true", dest="solve_ef")
     p.add_argument("--EF-integer", action="store_true", dest="ef_integer")
@@ -147,6 +158,7 @@ def config_from_args(args) -> RunConfig:
         model_kwargs=json.loads(args.model_kwargs),
         num_bundles=args.num_bundles, hub=args.hub, algo=algo,
         spokes=spokes, rel_gap=args.rel_gap, abs_gap=args.abs_gap,
+        incumbent_mode=args.incumbent_mode,
         solve_ef=args.solve_ef, ef_integer=args.ef_integer,
         trace_prefix=args.trace_prefix, telemetry_dir=args.telemetry_dir,
         status_port=args.status_port, status_host=args.status_host,

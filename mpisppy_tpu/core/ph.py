@@ -144,6 +144,37 @@ def _ph_combine(xn, prob, xbar_w, memberships, W, rho, wmask, *,
     return xbar_new, xsqbar_new, W_new, conv
 
 
+@jax.jit
+def _pool_rows_zeroed(x, yA, yB, zA, zB, keep):
+    """Zero the warm-start iterates of the pool rows whose candidate
+    came back INFEASIBLE: an infeasible solve's iterates are diverged
+    (huge duals) and warm-starting the next round's candidate from them
+    can mis-converge under the corrupt scale (the calculate_incumbent
+    poisoning fix, batched). Zero iterates are a valid warm start under
+    any rho_scale, so the factor/scale trajectory is kept. Iterate
+    VECTORS only cross this jit — the state's (possibly multi-GB)
+    factor container must not ride a jit boundary (XLA copies it)."""
+    r = lambda a: jnp.where(keep[:, None] if a.ndim > 1 else keep, a, 0.0)
+    return r(x), r(yA), r(yB), r(zA), r(zB)
+
+
+@jax.jit
+def _pool_assemble(lb, ub, l, u, c, c0, vals, pin_mask, idx, sidx, pidx):
+    """Chunk assembly for the batched incumbent-pool evaluation
+    (ops/incumbent, doc/incumbents.md): gather the chunk's scenario rows
+    and pin the candidates' nonant boxes (l = u = x̂ on the pinned
+    slots). Row r of a pool solve is (candidate pidx[r], scenario
+    sidx[r]) — the pool axis rides the existing batch axis, so the
+    chunk is an ordinary shared-factor solve. MODULE-LEVEL like
+    _ph_assemble: every engine shares one jit cache entry per shape,
+    and nothing large is baked in as a literal."""
+    lb_c, ub_c = lb[sidx], ub[sidx]
+    v = vals[pidx]                                   # (rows, K)
+    lb_c = lb_c.at[:, idx].set(jnp.where(pin_mask, v, lb_c[:, idx]))
+    ub_c = ub_c.at[:, idx].set(jnp.where(pin_mask, v, ub_c[:, idx]))
+    return lb_c, ub_c, l[sidx], u[sidx], c[sidx], c0[sidx]
+
+
 def _hot_eps(prox_on, sub_eps, sub_eps_hot):
     """The effective primal tolerance of a solve — THE policy both the
     dispatch and any quality gate (chunk recovery) must share."""
@@ -153,7 +184,8 @@ def _hot_eps(prox_on, sub_eps, sub_eps_hot):
 def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                  sub_max_iter, sub_eps, sub_eps_hot, sub_eps_dua_hot,
                  tail_iter, stall_rel, segment, polish_hot, polish_chunk,
-                 segment_lo=None, ir_sweeps=1, donate=False, kernel=None):
+                 segment_lo=None, ir_sweeps=1, donate=False, kernel=None,
+                 adaptive_rho=True):
     """The ONE precision-policy + solver dispatch, shared by the fused
     step and the chunked loop (a second copy would silently drift).
 
@@ -173,7 +205,14 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
     routes the solve through ONE device program (doc/kernels.md)
     instead of the host-segmented drivers below; None — including
     every recovery/hospital caller, which deliberately clears it — is
-    today's segmented path, bit-for-bit."""
+    today's segmented path, bit-for-bit.
+
+    ``adaptive_rho=False`` freezes the stepsize trajectory: the
+    incumbent-pool evaluator requires it because shared-mode rho
+    adaptation is computed from the geometric mean over ALL batch rows
+    — a pool's infeasible members contaminate the shared scalar and
+    the feasible candidates mis-converge (measured 13% objective
+    inflation on the UC fixture; doc/incumbents.md)."""
     e_pri = _hot_eps(prox_on, sub_eps, sub_eps_hot)
     e_dua = sub_eps_dua_hot if (prox_on and sub_eps_dua_hot is not None) \
         else sub_eps
@@ -185,7 +224,7 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
             max_iter=sub_max_iter, tail_iter=tail_iter, e_pri=e_pri,
             e_dua=e_dua, stall_rel=stall_rel, polish=do_polish,
             polish_chunk=polish_chunk, ir_sweeps=ir_sweeps,
-            donate=donate)
+            adaptive_rho=adaptive_rho, donate=donate)
     if precision in ("mixed", "df32"):
         # df32 differs from mixed only in the data representation (the
         # engine's A is a SplitMatrix, see spbase) — the driver is the
@@ -199,14 +238,16 @@ def _solver_call(factors, d, q, qp_state, *, prox_on, precision,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
                               stall_rel=stall_rel, segment=segment,
                               segment_lo=segment_lo, polish=do_polish,
-                              ir_sweeps=ir_sweeps, donate=donate)
+                              ir_sweeps=ir_sweeps,
+                              adaptive_rho=adaptive_rho, donate=donate)
     return qp_solve_segmented(factors, d, q, qp_state,
                               max_iter=sub_max_iter, segment=segment,
                               eps_abs=e_pri, eps_rel=e_pri,
                               polish_chunk=polish_chunk,
                               eps_abs_dua=e_dua, eps_rel_dua=e_dua,
                               stall_rel=stall_rel, polish=do_polish,
-                              ir_sweeps=ir_sweeps, donate=donate)
+                              ir_sweeps=ir_sweeps,
+                              adaptive_rho=adaptive_rho, donate=donate)
 
 
 def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
@@ -458,6 +499,11 @@ class PHBase(SPBase):
         # donating one chunk's would delete its siblings') and the
         # per-phase wall-clock/sync accounting the bench and tests read
         self._chunk_donatable = set()
+        # batched incumbent-pool evaluation (ops/incumbent): per-
+        # (pool, chunk) warm-start states + the donation crash window,
+        # exactly the chunked loop's pattern (see evaluate_incumbent_pool)
+        self._pool_states = {}
+        self._pool_dirty = set()
         # modes whose donating pass is in flight: set before pass 1
         # consumes the warm-start buffers, cleared once pass 3 stores
         # their successors — a crash in between leaves the cached
@@ -651,6 +697,9 @@ class PHBase(SPBase):
         self._chunk_donatable.clear()
         self._chunk_dirty.clear()
         getattr(self, "_chunk_idx_cache", {}).clear()
+        # pool states hold factors-derived L buffers — same lifetime
+        self._pool_states.clear()
+        self._pool_dirty.clear()
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
@@ -1870,6 +1919,19 @@ class PHBase(SPBase):
             pri = np.asarray(st.pri_res)
             rel = np.asarray(st.pri_rel)
             if not np.all((pri <= feas_tol) | (rel <= feas_tol)):
+                # an infeasible candidate leaves a DIVERGED state
+                # behind (blown rho_scale, ~1e9 duals measured on
+                # farmer): warm-starting the NEXT candidate from it can
+                # "converge" by the corrupt scale's relative criteria
+                # to a wrong objective. Drop it so the next evaluation
+                # restarts clean (ISSUE 9: surfaced by the pool
+                # equivalence tests; the candidate streams of every x̂
+                # spoke hit the same sequence). Chunked engines keep
+                # the authoritative warm starts under the "chunks" key
+                # — both must go, or the next chunked solve warm-starts
+                # from the same diverged states.
+                self._qp_states.pop(("fixed", False), None)
+                self._qp_states.pop(("chunks", ("fixed", False)), None)
                 return None
             return self.Eobjective_value()
         finally:
@@ -1951,6 +2013,206 @@ class PHBase(SPBase):
         """(S, K) latest subproblem nonant values for cylinder traffic
         (ref. phbase.py:562-617 nonant flat caches)."""
         return self.nonants_of(self.x)
+
+    # ------------- batched incumbent-pool evaluation -------------
+    def _pool_chunk_index(self, P, chunk):
+        """(scenario_idx, candidate_idx, real) per pool chunk: pool
+        solves linearize the (candidate, scenario) grid as rows
+        r = p*S + s and microbatch them exactly like the PH hot loop
+        (``subproblem_chunk`` bounds the rows per solve call; the tail
+        chunk pads by repeating its last row so every call compiles
+        once). Cached beside the PH chunk index (same invalidation)."""
+        S = self.batch.S
+        rows = P * S
+        if not hasattr(self, "_chunk_idx_cache"):
+            self._chunk_idx_cache = {}
+        key = ("pool", P, chunk, S)
+        if key not in self._chunk_idx_cache:
+            out = []
+            for i in range(0, rows, chunk):
+                r = np.arange(i, min(i + chunk, rows))
+                real = r.size
+                if real < chunk:
+                    r = np.concatenate([r, np.full(chunk - real, r[-1])])
+                out.append((jnp.asarray(r % S), jnp.asarray(r // S), real))
+            self._chunk_idx_cache[key] = out
+        return self._chunk_idx_cache[key]
+
+    def evaluate_incumbent_pool(self, pool, pin_mask=None, feas_tol=None):
+        """Batched fix-and-dive evaluation of a (P, K) candidate pool
+        (ops/incumbent, doc/incumbents.md): every candidate's pinned
+        nonant slots are fixed (l = u = x̂ bound tightening) across ALL
+        scenarios, the continuous recourse re-solves through the
+        standard donated warm-start kernel path
+        (``subproblem_kernel_mode`` honored — the pool rows are
+        literally more chunks of the pipelined dispatch), and the
+        feasibility screen + Eobjective land in ONE stacked D2H verdict
+        per call (``incumbent.gate_syncs`` stays O(1) per round on any
+        mesh). Returns host ``(objs (P,), feasible (P,) bool)`` with
+        infeasible candidates' objectives at +inf.
+
+        The vmapped-over-the-pool-axis semantics are exactly P
+        sequential ``calculate_incumbent`` calls (the equivalence is
+        pinned by tests/test_incumbent.py); the batched spelling costs
+        one warm-started chunk pass instead of P full solve_loop
+        passes. Falls back to that sequential path for the shapes the
+        chunked solver cannot batch (per-scenario A) or that need the
+        per-candidate recourse-integer dive."""
+        if feas_tol is None:
+            feas_tol = float(self.options.get("xhat_feas_tol", 1e-4))
+        pool = jnp.asarray(pool, self.dtype)
+        P, S = int(pool.shape[0]), self.batch.S
+        n = self.batch.n
+        idx_np = np.asarray(self.batch.nonant_idx)
+        nonant_cols = np.zeros(n, bool)
+        nonant_cols[idx_np] = True
+        rec_ints = np.asarray(self.batch.integer, bool) & ~nonant_cols
+        factors, d0 = self._get_factors(False, fixed=True)
+        if (rec_ints.any() and self.options.get("xhat_dive_integers",
+                                                True)) \
+                or factors.A_s.ndim != 2:
+            # integer RECOURSE columns need the per-candidate dive, and
+            # per-scenario matrices carry per-scenario factors the
+            # pool's shared-factor chunking cannot batch — evaluate
+            # sequentially through the reference path instead
+            objs = np.full(P, np.inf)
+            feas = np.zeros(P, bool)
+            for p in range(P):
+                v = self.calculate_incumbent(np.asarray(pool[p]),
+                                             feas_tol=feas_tol,
+                                             pin_mask=pin_mask)
+                if v is not None:
+                    objs[p] = v
+                    feas[p] = True
+            obs.counter_add("incumbent.gate_syncs", P)
+            return objs, feas
+        from ..ops.incumbent import pool_verdict
+        from ..ops.qp_solver import SplitMatrix, qp_objective
+        K = self.batch.K
+        pin = np.ones(K, bool) if pin_mask is None \
+            else np.asarray(pin_mask, bool)
+        # integral snap on the integer slots the candidate pins —
+        # build_pool rows are already integral; snapping here keeps the
+        # calculate_incumbent round_nonants contract for raw callers
+        imask = jnp.asarray(self.nonant_integer_mask)
+        vals = jnp.where(imask, jnp.round(pool), pool)
+        pmb = jnp.asarray(pin)
+        rows = P * S
+        copt = int(self.options.get("subproblem_chunk", 0))
+        chunk = copt if (copt and copt < rows) else rows
+        slices = self._pool_chunk_index(P, chunk)
+        plan = self._kernel_plan(("fixed", False), factors, chunk)
+        polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
+        kw = dict(prox_on=False, precision=self.sub_precision,
+                  sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
+                  sub_eps_hot=self.sub_eps_hot,
+                  sub_eps_dua_hot=self.sub_eps_dua_hot,
+                  tail_iter=self.sub_tail_iter,
+                  stall_rel=self.sub_stall_rel, segment=self.sub_segment,
+                  polish_hot=self.sub_polish_hot,
+                  polish_chunk=polish_chunk,
+                  segment_lo=self.sub_segment_lo,
+                  ir_sweeps=self.sub_ir_sweeps, kernel=plan,
+                  # FIXED stepsize: shared-mode rho adaptation is a
+                  # geometric mean over the batch rows, and a pool
+                  # always contains infeasible members whose diverging
+                  # ratios contaminate the shared scalar (measured 13%
+                  # objective inflation on the feasible UC candidate) —
+                  # the eq-boosted fixed-mode rho pattern carries the
+                  # pinned solves fine at scale 1
+                  adaptive_rho=False)
+        ck = (P, chunk)
+        if ck in self._pool_dirty:
+            # a previous donating pass died mid-flight: its cached
+            # states reference deleted buffers — rebuild cold
+            self._pool_states.pop(ck, None)
+            self._pool_dirty.discard(ck)
+        states = self._pool_states.get(ck)
+        fresh = states is None
+        if fresh:
+            # ONE cold state serves every chunk (identical shapes,
+            # immutable buffers — see _ensure_chunk_states); donation
+            # waits for the first completed pass to privatize them
+            sidx0, pidx0, _ = slices[0]
+            lb0, ub0, l0, u0, _, _ = _pool_assemble(
+                d0.lb, d0.ub, d0.l, d0.u, self.c, self.c0, vals, pmb,
+                self.nonant_idx, sidx0, pidx0)
+            st0 = qp_cold_state(factors, d0._replace(lb=lb0, ub=ub0,
+                                                     l=l0, u=u0))
+            states = [st0] * len(slices)
+            self._pool_states[ck] = states
+        donate = (not fresh) \
+            and bool(int(self.options.get("subproblem_pipeline", 1))) \
+            and bool(int(self.options.get("subproblem_donate", 1)))
+        if donate:
+            self._pool_dirty.add(ck)
+            obs.counter_add("qp.donated_passes")
+        split_mode = isinstance(factors.A_s, SplitMatrix)
+        prev_st = None
+        outs = []
+        for ci, (sidx, pidx, _) in enumerate(slices):
+            lb_c, ub_c, l_c, u_c, q_c, c0_c = _pool_assemble(
+                d0.lb, d0.ub, d0.l, d0.u, self.c, self.c0, vals, pmb,
+                self.nonant_idx, sidx, pidx)
+            d_c = d0._replace(lb=lb_c, ub=ub_c, l=l_c, u=u_c)
+            st_in = states[ci]
+            if split_mode and prev_st is not None:
+                # df32 chunks FLOW one (rho_scale, factor) pair — the
+                # chunked hot loop's HBM discipline (one ~GB factor
+                # alive, not one per chunk)
+                st_in = st_in._replace(L=prev_st.L,
+                                       rho_scale=prev_st.rho_scale)
+            st, x, _, _ = _solver_call(factors, d_c, q_c, st_in,
+                                       donate=donate, **kw)
+            prev_st = st
+            if split_mode:
+                st = st._replace(L=jnp.zeros((), jnp.float32))
+            states[ci] = st
+            outs.append((qp_objective(d_c, q_c, c0_c, x),
+                         st.pri_res, st.pri_rel))
+        if split_mode and prev_st is not None:
+            for ci in range(len(states)):
+                states[ci] = states[ci]._replace(
+                    L=prev_st.L, rho_scale=prev_st.rho_scale)
+        # donation window closed: states are solve outputs with
+        # privately owned buffers — the next round may donate them
+        self._pool_dirty.discard(ck)
+        obj_rows = jnp.concatenate([o for o, _, _ in outs])[:rows]
+        pri_res = jnp.concatenate([r for _, r, _ in outs])[:rows]
+        pri_rel = jnp.concatenate([r for _, _, r in outs])[:rows]
+        live = jnp.asarray(np.arange(S) < self._S_orig)
+        v = np.asarray(pool_verdict(obj_rows, pri_res, pri_rel, self.prob,
+                                    live, feas_tol, P=P, S=S))
+        # THE one stacked D2H of the round (the chunked loop's fused-
+        # gate discipline — doc/pipelining.md)
+        obs.counter_add("incumbent.gate_syncs")
+        if obs.enabled():
+            obs.counter_add("xfer.d2h_bytes", v.nbytes)
+            if plan.mode == "fused":
+                # post-verdict scalar copies, not stalls (the verdict
+                # already synced every chunk's program)
+                obs.counter_add("kernel.fused_iters",
+                                sum(int(s.iters) for s in states))
+        feas = v[1] > 0.5
+        if not feas.all():
+            # cold-reset the infeasible candidates' rows before the
+            # states are reused as next round's warm starts (see
+            # _pool_rows_zeroed); tail-chunk pad rows duplicate the
+            # LAST candidate's rows, so they inherit ITS verdict — a
+            # blanket keep would preserve diverged pad iterates when
+            # that candidate is infeasible
+            keep = np.repeat(feas, S)
+            keep = np.concatenate(
+                [keep, np.full(len(slices) * chunk - rows, feas[-1])])
+            for ci in range(len(states)):
+                kc = jnp.asarray(keep[ci * chunk:(ci + 1) * chunk])
+                st = states[ci]
+                x_z, yA_z, yB_z, zA_z, zB_z = _pool_rows_zeroed(
+                    st.x, st.yA, st.yB, st.zA, st.zB, kc)
+                states[ci] = st._replace(x=x_z, yA=yA_z, yB=yB_z,
+                                         zA=zA_z, zB=zB_z)
+        objs = np.where(feas, v[0], np.inf)
+        return objs, feas
 
     # ------------- extension hooks (ref. extensions/extension.py:14) -------------
     def _ext(self, hook):

@@ -5,11 +5,13 @@ nonants to (scenario x var), take the per-var MAX (SlamUp) or MIN
 (SlamDown) across all scenarios, round integers, fix everything, evaluate.
 The reference's local-then-Allreduce(MAX/MIN) two-step collapses to one
 axis reduction over the batched nonant block.
+
+The same two rows ride the device incumbent pool as members
+(ops/incumbent.build_pool slam block, doc/incumbents.md) —
+ops/incumbent.slam_rows is the one host implementation both share.
 """
 
 from __future__ import annotations
-
-import numpy as np
 
 from .xhat_bounders import _XhatInnerBound
 
@@ -19,8 +21,11 @@ class _SlamHeuristic(_XhatInnerBound):
     mpi_op = None  # "max" | "min"
 
     def candidates(self, X):
-        red = np.max if self.mpi_op == "max" else np.min
-        yield red(X, axis=0)
+        # lazy: ops.incumbent imports jax, and this module historically
+        # stays importable without touching the device runtime
+        from ..ops.incumbent import slam_rows
+        up, down = slam_rows(X)
+        yield up if self.mpi_op == "max" else down
 
 
 class SlamUpHeuristic(_SlamHeuristic):

@@ -309,6 +309,44 @@ def fault_summary(run: Run) -> dict:
     return out
 
 
+def incumbent_summary(run: Run) -> dict | None:
+    """Device incumbent-pool activity (ops/incumbent, doc/incumbents.md):
+    ``incumbent.*`` counters summed across roles (the dive spoke runs
+    in its own process in a multi-process wheel, so its counters land
+    in a role-suffixed metrics snapshot) plus the per-round
+    ``incumbent.round`` event trajectory. None when no pool ever ran —
+    the section only renders for wheels with a pool-driven spoke."""
+    tot = {}
+    for role in run.metrics:
+        for k, v in run.counters(role).items():
+            if k.startswith("incumbent."):
+                tot[k] = tot.get(k, 0) + v
+    rounds_ev = run.of("incumbent.round")
+    if not tot and not rounds_ev:
+        return None
+    rounds = int(tot.get("incumbent.rounds", 0)) or len(rounds_ev)
+    evaluated = int(tot.get("incumbent.candidates_evaluated", 0))
+    improvements = int(tot.get("incumbent.improvements", 0))
+    return {
+        "rounds": rounds,
+        # pool throughput: candidates per round (the static pool size
+        # whenever at least one round completed its evaluation)
+        "pool_size": (evaluated // rounds) if rounds else 0,
+        "candidates_evaluated": evaluated,
+        "feasible": int(tot.get("incumbent.feasible", 0)),
+        "improvements": improvements,
+        "accept_rate": (improvements / rounds) if rounds else 0.0,
+        "pool_reused": int(tot.get("incumbent.pool_reused", 0)),
+        "oracle_polish": int(tot.get("incumbent.oracle_polish", 0)),
+        "gate_syncs": int(tot.get("incumbent.gate_syncs", 0)),
+        "trajectory": [
+            {"round": e.get("round"), "best": e.get("best"),
+             "bound": e.get("bound"),
+             "improved": bool(e.get("improved"))}
+            for e in rounds_ev],
+    }
+
+
 def bound_flow_summary(run: Run) -> dict | None:
     """Per-spoke bound-flow ledger + verdict — the live-plane answer to
     ROADMAP item 1's diagnostic question ("is the Lagrangian spoke
@@ -674,9 +712,28 @@ def render_report(run: Run) -> str:
                     "should not device_put]"))
         L.append("")
 
+    inc = incumbent_summary(run)
+    if inc is not None:
+        L.append("== incumbent ==")
+        L.append(f"pool rounds {inc['rounds']}  pool size "
+                 f"{inc['pool_size']}  candidates "
+                 f"{inc['candidates_evaluated']} ({inc['feasible']} "
+                 f"feasible)  improvements {inc['improvements']} "
+                 f"(accept rate {_fmt(inc['accept_rate'], 2)})")
+        L.append(f"pool reuse skips {inc['pool_reused']}  oracle "
+                 f"polish {inc['oracle_polish']}  gate syncs "
+                 f"{inc['gate_syncs']}")
+        traj = [t for t in inc["trajectory"]
+                if t.get("best") is not None]
+        if traj:
+            L.append("best-value trajectory (round: best): "
+                     + "  ".join(f"{t['round']}: {_fmt(t['best'], 2)}"
+                                 for t in traj[-6:]))
+        L.append("")
+
     L.append("== counters ==")
     for k in sorted(c):
-        if k.split(".")[0] in ("ph", "qp", "hub", "spoke"):
+        if k.split(".")[0] in ("ph", "qp", "hub", "spoke", "incumbent"):
             L.append(f"  {k} = {_fmt(c[k])}")
     L.append("")
 
@@ -1092,6 +1149,7 @@ def main(argv=None) -> int:
                 "compile": {k: v for k, v in compile_summary(run).items()
                             if k != "entries"},
                 "sharding": sharding_summary(run),
+                "incumbent": incumbent_summary(run),
                 "faults": fault_summary(run),
                 "bound_flow": (bf := bound_flow_summary(run)),
                 "invariants": [

@@ -211,10 +211,15 @@ def prepare(factors, *, mode="auto", backend="reference",
 def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
                  precision, max_iter, tail_iter, e_pri, e_dua,
                  stall_rel, polish, polish_chunk, ir_sweeps,
-                 check_every=25, polish_iters=12, donate=False):
+                 check_every=25, polish_iters=12, adaptive_rho=True,
+                 donate=False):
     """The fused-mode twin of core/ph._solver_call's segmented
     dispatch: same (state, x, yA, yB) contract, same tolerance policy
-    (the caller computed e_pri/e_dua), one device program per call."""
+    (the caller computed e_pri/e_dua), one device program per call.
+    ``adaptive_rho=False`` freezes the stepsize trajectory — the
+    incumbent-pool evaluator needs it because shared-mode adaptation
+    pools statistics over rows that include INFEASIBLE candidates
+    (doc/incumbents.md)."""
     t0 = time.perf_counter()
     if plan.backend == "pallas" and precision not in ("mixed", "df32") \
             and not pallas_kernel.pallas_supported(factors, state):
@@ -233,7 +238,8 @@ def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
             eps_rel=e_pri, eps_abs_dua=e_dua, eps_rel_dua=e_dua,
             polish=polish, polish_iters=polish_iters,
             polish_chunk=polish_chunk, stall_rel=stall_rel,
-            ir_sweeps=ir_sweeps, l_inv=plan.l_inv, donate=donate)
+            ir_sweeps=ir_sweeps, l_inv=plan.l_inv,
+            adaptive_rho=adaptive_rho, donate=donate)
         tag = "fused-mixed"
     elif plan.backend == "pallas":
         # the pallas block runs the WHOLE budget at fixed rho (the
@@ -254,7 +260,8 @@ def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
             check_every=check_every, eps_abs=e_pri, eps_rel=e_pri,
             polish=polish, polish_iters=polish_iters,
             polish_chunk=polish_chunk, eps_abs_dua=e_dua,
-            eps_rel_dua=e_dua, stall_rel=stall_rel, ir_sweeps=ir_sweeps)
+            eps_rel_dua=e_dua, stall_rel=stall_rel, ir_sweeps=ir_sweeps,
+            adaptive_rho=adaptive_rho)
         st = st._replace(iters=jnp.asarray(int(max_iter), jnp.int32))
         tag = "fused-pallas"
     else:
@@ -263,7 +270,8 @@ def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
             check_every=check_every, eps_abs=e_pri, eps_rel=e_pri,
             polish=polish, polish_iters=polish_iters,
             polish_chunk=polish_chunk, eps_abs_dua=e_dua,
-            eps_rel_dua=e_dua, stall_rel=stall_rel, ir_sweeps=ir_sweeps)
+            eps_rel_dua=e_dua, stall_rel=stall_rel, ir_sweeps=ir_sweeps,
+            adaptive_rho=adaptive_rho)
         tag = "fused-native"
     # same observability contract as the segmented drivers' per-segment
     # stamps (counter + optional MPISPPY_TPU_SOLVE_TRACE event), one

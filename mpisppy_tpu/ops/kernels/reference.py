@@ -250,7 +250,7 @@ def fused_mixed_solve(factors, A_lo, data, q, state, *, bulk_iter,
                       tail_iter, check_every, eps_abs, eps_rel,
                       eps_abs_dua, eps_rel_dua, polish, polish_iters,
                       polish_chunk, stall_rel, ir_sweeps, l_inv,
-                      donate=False):
+                      adaptive_rho=True, donate=False):
     """One fused mixed/df32 solve call (see _fused_mixed_impl).
     ``l_inv`` states arriving with a raw 2-D f32 Cholesky factor are
     wrapped to LInv EAGERLY so the jit sees one pytree structure for the
@@ -268,7 +268,7 @@ def fused_mixed_solve(factors, A_lo, data, q, state, *, bulk_iter,
               eps_abs, eps_rel, eps_abs_dua, eps_rel_dua,
               bulk_iter=int(bulk_iter), tail_iter=int(tail_iter),
               check_every=int(check_every),
-              adaptive_rho=True, polish=bool(polish),
+              adaptive_rho=bool(adaptive_rho), polish=bool(polish),
               polish_iters=int(polish_iters),
               polish_chunk=int(polish_chunk), stall_rel=float(stall_rel),
               ir_sweeps=int(ir_sweeps), l_inv=bool(l_inv))

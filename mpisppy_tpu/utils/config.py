@@ -38,7 +38,14 @@ KERNEL_BLOCK_DTYPES = ("auto", "bf16", "f32")
 FUSED_IR_SWEEPS = range(1, 5)
 KNOWN_SPOKES = ("lagrangian", "lagranger", "xhatshuffle", "xhatlooper",
                 "xhatspecific", "xhatlshaped", "fwph", "slamup",
-                "slamdown", "cross_scenario", "efmip")
+                "slamdown", "cross_scenario", "efmip", "dive")
+# incumbent source policy for the x̂ / dive spokes (doc/incumbents.md):
+# "device" = batched on-device pool/dive only (host OraclePool never
+# constructed), "oracle" = host-oracle sources only, "auto" = device
+# sources with the oracle as the opt-in fallback/polish. Defined HERE
+# (jax-free) like the kernel constants: cylinder validation and the
+# CLI both read it.
+INCUMBENT_MODES = ("device", "oracle", "auto")
 KNOWN_HUBS = ("ph", "aph", "lshaped")
 
 
@@ -159,6 +166,11 @@ class RunConfig:
     spokes: list = field(default_factory=list)   # list[SpokeConfig]
     rel_gap: float | None = None
     abs_gap: float | None = None
+    # run-level incumbent source policy (INCUMBENT_MODES above): seeds
+    # every inner-bound spoke's ``incumbent_mode`` option (per-spoke
+    # options win). None keeps each spoke's own default ("auto"; the
+    # dive spoke defaults to "device").
+    incumbent_mode: str | None = None
     solve_ef: bool = False           # solve the EF instead of a wheel
     ef_integer: bool = False
     trace_prefix: str | None = None
@@ -220,6 +232,11 @@ class RunConfig:
             raise ValueError("abs_gap must be >= 0")
         if self.wheel_deadline is not None and self.wheel_deadline <= 0:
             raise ValueError("wheel_deadline must be positive")
+        if self.incumbent_mode is not None \
+                and self.incumbent_mode not in INCUMBENT_MODES:
+            raise ValueError(
+                f"unknown incumbent_mode {self.incumbent_mode!r}; "
+                f"known: {INCUMBENT_MODES}")
         if self.status_port is not None \
                 and not (0 <= int(self.status_port) <= 65535):
             raise ValueError("status_port must be in [0, 65535] "

@@ -212,6 +212,15 @@ class OraclePool:
         def drive(w):
             try:
                 while not abort.is_set():
+                    if kill_check is not None and kill_check():
+                        # respect the kill BETWEEN queued tasks too
+                        # (ISSUE 9 satellite): a worker finishing one
+                        # MIP used to grab the next task in the window
+                        # before the main poll loop reacted, so a
+                        # quarantined/terminating spoke could wait out
+                        # a full oracle batch one time_limit at a time
+                        abort.set()
+                        return
                     try:
                         t = tq.get_nowait()
                     except queue.Empty:
@@ -243,6 +252,13 @@ class OraclePool:
         if errors:
             self._terminate_pool()
             raise RuntimeError("oracle pool worker failed") from errors[0]
+        if kill_check is not None and kill_check():
+            # the kill may have landed via a drive thread's own check
+            # (or between the last join and here) with every thread
+            # already exited — partial results must not masquerade as a
+            # completed batch
+            self._terminate_pool()
+            return None
         return results
 
     # -- public API --

@@ -150,7 +150,8 @@ def spoke_classes(kind: str):
     from ..core.lshaped import LShapedMethod
     from ..cylinders.lagrangian_bounder import (LagrangianOuterBound,
                                                 LagrangerOuterBound)
-    from ..cylinders.xhat_bounders import (XhatLooperInnerBound,
+    from ..cylinders.xhat_bounders import (DiveInnerBound,
+                                           XhatLooperInnerBound,
                                            XhatShuffleInnerBound,
                                            XhatSpecificInnerBound,
                                            XhatLShapedInnerBound)
@@ -172,6 +173,8 @@ def spoke_classes(kind: str):
         "slamup": (SlamUpHeuristic, PHBase),
         "slamdown": (SlamDownHeuristic, PHBase),
         "cross_scenario": (CrossScenarioCutSpoke, LShapedMethod),
+        # device-side batched incumbent search (doc/incumbents.md)
+        "dive": (DiveInnerBound, PHBase),
     }[kind]
 
 
@@ -184,6 +187,10 @@ def spoke_dict(cfg: RunConfig, sp: SpokeConfig, batch=None):
     # replaces SPOKE_SLEEP_TIME monkeypatching in fast fault scenarios
     if cfg.spoke_sleep_time is not None:
         options.setdefault("spoke_sleep_time", cfg.spoke_sleep_time)
+    if cfg.incumbent_mode is not None:
+        # run-level incumbent source policy (doc/incumbents.md); only
+        # the x̂-family spokes read it, and per-spoke options still win
+        options.setdefault("incumbent_mode", cfg.incumbent_mode)
     dtype_kw = _pop_dtype(options)
     spoke_kwargs = {}
     if cfg.trace_prefix:

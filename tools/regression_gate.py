@@ -41,10 +41,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "ci", "golden_farmer_telemetry")
 
 # the golden run's exact recipe — regeneration and the fresh side must
-# match, or the compare diffs configuration instead of code
+# match, or the compare diffs configuration instead of code. --with-dive
+# (ISSUE 9) keeps the device incumbent-pool path inside the gate so a
+# regression in its counters/compiles fails here at tier-1 speed.
 BENCH_ARGS = ["farmer", "--num-scens", "3", "--max-iterations", "5",
               "--convthresh", "-1", "--subproblem-max-iter", "1500",
-              "--with-lagrangian", "--with-xhatshuffle",
+              "--with-lagrangian", "--with-xhatshuffle", "--with-dive",
               "--rel-gap", "1e-6"]
 
 
