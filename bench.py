@@ -493,6 +493,15 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
             rows[0]["bound_flow"] = hub.bound_flow_status()
         except Exception:
             pass    # a kill-path flush must never die on diagnostics
+    # durable-checkpoint stamp (ISSUE 10): a checkpointing wheel's row
+    # records the last bundle + its iteration, so a DNF/killed row
+    # says exactly what a relaunch would resume from (manager status
+    # is plain attribute reads — signal-safe like bound_flow_status)
+    if rows and getattr(hub, "ckpt", None) is not None:
+        try:
+            rows[0]["checkpoint"] = hub.ckpt.status()
+        except Exception:
+            pass
     # device incumbent-pool anatomy (ISSUE 9): mode, pool shape, round
     # and improvement counts of the timed window, so the gap row says
     # whether the inner bound came from the device pool or the host

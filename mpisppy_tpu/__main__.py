@@ -100,6 +100,26 @@ def make_parser() -> argparse.ArgumentParser:
                         "loopback; the endpoints serve full run state "
                         "unauthenticated — pass 0.0.0.0 only to opt "
                         "into remote scraping)")
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="durable run-state checkpoints: the hub "
+                        "captures manifest'd bundles (W, x̄, ρ, "
+                        "bounds, per-spoke warm state) here — "
+                        "periodic, plus forced on watchdog fire and "
+                        "SIGTERM (the preemption notice). See "
+                        "doc/fault_tolerance.md")
+    p.add_argument("--checkpoint-interval", type=float, default=30.0,
+                   help="seconds between periodic checkpoint bundles "
+                        "(default 30)")
+    p.add_argument("--checkpoint-keep", type=int, default=3,
+                   help="retain the newest N bundles (default 3); "
+                        "LATEST always points at the newest")
+    p.add_argument("--resume-from", type=str, default=None,
+                   help="relaunch the wheel from a checkpoint bundle "
+                        "(or a --checkpoint-dir, resolved through its "
+                        "LATEST pointer): hub state + best-bound "
+                        "ledger + spoke warm state restored; a "
+                        "corrupt or config-mismatched bundle falls "
+                        "back to cold start with a reasoned event")
     p.add_argument("--wheel-deadline", type=float, default=None,
                    help="watchdog: cleanly terminate the wheel after "
                         "this many seconds (kill signal to spokes, "
@@ -163,6 +183,10 @@ def config_from_args(args) -> RunConfig:
         trace_prefix=args.trace_prefix, telemetry_dir=args.telemetry_dir,
         status_port=args.status_port, status_host=args.status_host,
         wheel_deadline=args.wheel_deadline,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_interval=args.checkpoint_interval,
+        checkpoint_keep=args.checkpoint_keep,
+        resume_from=args.resume_from,
         mesh_devices=args.mesh_devices, coordinator=coordinator,
     ).validate()
 

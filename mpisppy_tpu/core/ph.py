@@ -2226,7 +2226,9 @@ class PH(PHBase):
     def ph_main(self, finalize=True):
         self._ext("pre_iter0")
         # Iter 0: no W, no prox (ref. phbase.py:1364 Iter0). A warm start
-        # (WXBarReader / load_state) keeps the loaded W and solves with it
+        # (WXBarReader / load_state, or a checkpoint-bundle resume —
+        # ckpt.manager.resume_hub installs through the same
+        # install_state_arrays body) keeps the loaded W and solves with it
         # on — the dual bound of that pass is a valid Lagrangian bound since
         # PH-generated W satisfies sum_s p_s W_s = 0 per node. An xbar-only
         # warm start keeps the loaded prox center: iter 0 must not

@@ -102,6 +102,31 @@ class Hub(SPCommunicator):
         self._live_last_write = 0.0
         self._live_min_interval = float(
             self.options.get("live_snapshot_interval", 0.25))
+        # ---- durable run-state checkpoints (mpisppy_tpu.ckpt) ----
+        # "checkpoint_dir" arms the hub-owned CheckpointManager:
+        # periodic bundles from the termination-check path, forced
+        # bundles on watchdog fire / preemption (SIGTERM) / finalize.
+        # "resume_from" installs a validated bundle into the engine +
+        # the best-bound ledger BEFORE the first iteration; a corrupt
+        # or mismatched bundle is rejected with a reasoned event and
+        # the wheel cold-starts (doc/fault_tolerance.md).
+        self.ckpt = None
+        ckpt_dir = self.options.get("checkpoint_dir")
+        if ckpt_dir:
+            from ..ckpt.manager import CheckpointManager
+            self.ckpt = CheckpointManager(
+                self, ckpt_dir,
+                interval=self.options.get("checkpoint_interval"),
+                keep=self.options.get("checkpoint_keep"),
+                fingerprint=self.options.get("checkpoint_fingerprint"))
+        self._preempted = False
+        self._preempt_lock = threading.Lock()
+        resume_from = self.options.get("resume_from")
+        if resume_from:
+            from ..ckpt.manager import resume_hub
+            resume_hub(self, resume_from,
+                       fingerprint=self.options.get(
+                           "checkpoint_fingerprint"))
 
     @staticmethod
     def _new_flow():
@@ -494,6 +519,12 @@ class Hub(SPCommunicator):
                 "ob_char": self.latest_ob_char,
                 "ib_char": self.latest_ib_char,
                 "watchdog_fired": self._watchdog_fired,
+                "preempted": self._preempted,
+                # last-checkpoint stamp (None fields until the first
+                # capture) — the live plane's answer to "would a
+                # preemption right now lose anything?"
+                "checkpoint": self.ckpt.status()
+                if self.ckpt is not None else None,
                 "spokes": spokes}
         try:
             pt = self.opt.phase_timing(True) \
@@ -547,8 +578,40 @@ class Hub(SPCommunicator):
                    f"({source}); terminating with partial bounds "
                    f"outer {self.BestOuterBound:.6g} / inner "
                    f"{self.BestInnerBound:.6g}")
+        # a watchdog kill is a premature end: capture the state it
+        # would otherwise lose (forced — the interval must not skip
+        # the last chance)
+        if self.ckpt is not None:
+            self.ckpt.maybe_capture(force=True, reason="watchdog")
         # nonblocking: the timer thread may interrupt a frame holding a
         # sink lock (the same contract as bench's signal-handler flush)
+        self._write_live_snapshot(force=True)
+        obs.flush(nonblocking=True)
+        self.send_terminate()
+
+    def handle_preemption(self, source="sigterm"):
+        """The preemption notice path (SIGTERM on a preemptible pod —
+        utils/multiproc installs the handler when checkpointing is
+        armed, the wheel-level analog of bench.py's signal-safe
+        flush): force one final checkpoint bundle, flush telemetry
+        nonblocking, signal the spokes, and mark the wheel terminated
+        so the hub loop exits at its next check. Once-guarded; safe
+        from a signal frame (main thread) interrupting the hub loop."""
+        with self._preempt_lock:
+            if self._preempted:
+                return
+            self._preempted = True
+        fin = obs.finite_or_none
+        obs.counter_add("hub.preempted")
+        obs.event("hub.preempted",
+                  {"source": source,
+                   "iter": getattr(self.opt, "_iter", None),
+                   "outer": fin(self.BestOuterBound),
+                   "inner": fin(self.BestInnerBound)})
+        global_toc(f"WARNING: preemption notice ({source}); "
+                   "checkpointing and terminating")
+        if self.ckpt is not None:
+            self.ckpt.maybe_capture(force=True, reason="preempt")
         self._write_live_snapshot(force=True)
         obs.flush(nonblocking=True)
         self.send_terminate()
@@ -564,8 +627,16 @@ class Hub(SPCommunicator):
         return False
 
     def determine_termination(self) -> bool:
+        if self._preempted:
+            return True
         if self._wheel_deadline_exceeded():
             return True
+        # periodic durable checkpoint (rate-limited inside the
+        # manager, like the live.json throttle above) — the hub's
+        # termination check is the one place every hub family passes
+        # through between iterations
+        if self.ckpt is not None:
+            self.ckpt.maybe_capture()
         abs_gap, rel_gap = self.compute_gaps()
         if obs.enabled():
             # the hub half of the per-iteration convergence record
@@ -640,6 +711,11 @@ class Hub(SPCommunicator):
 
     def hub_finalize(self):
         self.receive_bounds()
+        # one last durable bundle so a relaunch resumes from the FINAL
+        # state (also covers watchdog/preempt wheels whose forced
+        # capture preceded the last spoke bounds)
+        if self.ckpt is not None:
+            self.ckpt.maybe_capture(force=True, reason="finalize")
         abs_gap, rel_gap = self.compute_gaps()
         global_toc(f"Final bounds: outer {self.BestOuterBound:.4f} / inner "
                    f"{self.BestInnerBound:.4f}, rel gap "

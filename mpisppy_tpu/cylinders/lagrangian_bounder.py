@@ -163,6 +163,40 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         self._projector = None
         self._last_mip_at = -float("inf")
         self._last_mip_ok = True
+        # warm resume (mpisppy_tpu.ckpt): the checkpointed dual block
+        # parked by install_spoke_state; lagrangian_prep bounds at it
+        # instead of the W=0 cold prep
+        self._resume_W = None
+
+    # ---- durable warm state (mpisppy_tpu.ckpt) ----
+    def spoke_state(self):
+        """+ the spoke's Lagrangian dual block (its engine's W, REAL
+        scenarios only — the wxbar portability contract, in case the
+        spoke engine is ever mesh-padded): a resumed/respawned
+        incarnation prep-bounds at the checkpointed duals instead of
+        the trivial W=0 point, so its first COMPUTED bound starts
+        where the dead generation's left off (the re-published best
+        rides resume_publish either way)."""
+        state = super().spoke_state()
+        S = getattr(self.opt, "_S_orig", self.opt.batch.S)
+        state["W"] = np.asarray(self.opt.W, np.float64)[:S]
+        return state
+
+    def install_spoke_state(self, state):
+        super().install_spoke_state(state)
+        W = state.get("W")
+        if W is None:
+            return
+        W = np.asarray(W, np.float64)
+        S_real = getattr(self.opt, "_S_orig", self.opt.batch.S)
+        if W.shape != (S_real, self.opt.batch.K):
+            return          # foreign shape: keep the cold W=0 prep
+        if self.opt.batch.S != S_real:
+            # mesh pads carry zero objective weight; zero duals there
+            # keep the padded block on the dual-feasible manifold
+            W = np.concatenate(
+                [W, np.zeros((self.opt.batch.S - S_real, W.shape[1]))])
+        self._resume_W = W
 
     def _oracle(self):
         # construction is locked: the async tightener thread and the
@@ -306,7 +340,25 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         reference-scale exact-LP pass costs on a 1-core host), and the
         exact oracle — when configured — starts as an asynchronous
         tightener at W=0 immediately, so its exact value lands during
-        the first hub iterations rather than gating them."""
+        the first hub iterations rather than gating them.
+
+        A RESUMED incarnation (checkpointed dual block installed by
+        install_spoke_state) skips the cold W=0 prep entirely and
+        bounds at its checkpointed duals — generation N picks up the
+        Lagrangian ascent where generation N-1 died."""
+        W = self._resume_W
+        if W is not None:
+            self._resume_W = None
+            W = self._project_W(np.asarray(W))
+            if self._device_duals:
+                self.update_bound(self._device_bound(W))
+                if self._exact and not self._mip:
+                    self._ensure_tightener().launch(np.asarray(W))
+            else:
+                b = self._fast_bound(W)
+                if b is not None:
+                    self.update_bound(b)
+            return
         if self._device_duals:
             self.update_bound(self._device_bound(None))
             if self._exact and not self._mip:

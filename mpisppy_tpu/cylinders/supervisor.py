@@ -19,7 +19,12 @@ Four mechanisms (doc/fault_tolerance.md has the full semantics):
   ``respawner`` callback on a FRESH window pair (generation-suffixed
   shm names; the dead generation's windows are retired in place and
   unlinked at wheel teardown), with capped exponential backoff
-  between attempts.
+  between attempts. With checkpointing armed (``checkpoint_dir``,
+  mpisppy_tpu.ckpt), the respawner's spawn body hands generation N
+  the latest warm-state file generation N-1 persisted, so a respawn
+  RESUMES the spoke — first published bound no worse than the dead
+  generation's best — instead of restarting it cold
+  (doc/fault_tolerance.md §checkpoint/resume).
 - **quarantine** — after ``max_respawns`` crashes (or
   ``max_rejections`` corrupt payloads flagged by the hub's ingest
   validation) the spoke is retired: removed from the hub's

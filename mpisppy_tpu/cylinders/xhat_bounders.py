@@ -76,6 +76,24 @@ class _XhatInnerBound(InnerBoundNonantSpoke):
                              f"{INCUMBENT_MODES}")
         self._incumbent_mode = mode
 
+    # ---- durable warm state (mpisppy_tpu.ckpt) ----
+    def spoke_state(self):
+        """+ the standing incumbent: a resumed incarnation re-publishes
+        its bound (base class) and keeps the nonant block that
+        produced it, so exact re-evaluation / oracle polish still has
+        the plan in hand."""
+        state = super().spoke_state()
+        if self.best_xhat is not None:
+            state["best_xhat"] = np.asarray(self.best_xhat,
+                                            np.float64)
+        return state
+
+    def install_spoke_state(self, state):
+        super().install_spoke_state(state)
+        xh = state.get("best_xhat")
+        if xh is not None:
+            self.best_xhat = np.asarray(xh)
+
     def candidates(self, X):
         """Yield (K,) or (S,K) candidate nonant blocks from hub nonants X."""
         raise NotImplementedError
@@ -457,6 +475,22 @@ class DiveInnerBound(_XhatInnerBound):
         self._dive_mask = binary if self._pin_mask is None \
             else (binary & self._pin_mask)
 
+    def spoke_state(self):
+        """+ the dive round counter — the RNG fold index: build_pool
+        folds the seed with the round, so restoring it keeps a resumed
+        incarnation's random exploration rows FRESH relative to every
+        pool the dead generation already evaluated (a reset counter
+        would replay them)."""
+        state = super().spoke_state()
+        state["rounds"] = int(self._rounds)
+        return state
+
+    def install_spoke_state(self, state):
+        super().install_spoke_state(state)
+        rounds = state.get("rounds")
+        if rounds is not None:
+            self._rounds = int(rounds)
+
     def main(self):
         while not self.got_kill_signal():
             if time.monotonic() - self._last_try < self._min_interval:
@@ -571,6 +605,19 @@ class XhatShuffleInnerBound(_XhatInnerBound):
         self._order = rng.permutation(S)        # ref. :108-111 seed 42
         self._pos = 0                           # ScenarioCycler resume point
         self._consensus_turn = False
+
+    def spoke_state(self):
+        """+ the cycler position, so a resumed incarnation continues
+        the shuffled epoch instead of re-walking its prefix."""
+        state = super().spoke_state()
+        state["pos"] = int(self._pos)
+        return state
+
+    def install_spoke_state(self, state):
+        super().install_spoke_state(state)
+        pos = state.get("pos")
+        if pos is not None:
+            self._pos = int(pos) % len(self._order)
 
     def _consensus_fresh(self):
         """A consensus candidate exists AND its dedup key is not in the

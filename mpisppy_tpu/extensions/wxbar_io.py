@@ -44,20 +44,29 @@ def _placer(opt):
 
 
 def save_state(opt, path):
-    """Checkpoint the PH algorithm state to ``path`` (npz)."""
+    """Checkpoint the PH algorithm state to ``path`` (npz). ATOMIC:
+    written to a temp sibling and ``os.replace``'d (the live.json
+    pattern) — ``np.savez`` straight onto the target could leave a
+    torn npz under a mid-write SIGKILL, exactly the preemption this
+    checkpoint exists to survive."""
+    from ..ckpt.bundle import atomic_savez
+
     S = _real_S(opt)
-    np.savez(path, W=np.asarray(opt.W)[:S], xbar=np.asarray(opt.xbar)[:S],
-             xsqbar=np.asarray(opt.xsqbar)[:S],
-             rho=np.asarray(opt.rho)[:S], iter=np.asarray(opt._iter))
+    atomic_savez(path, W=np.asarray(opt.W)[:S],
+                 xbar=np.asarray(opt.xbar)[:S],
+                 xsqbar=np.asarray(opt.xsqbar)[:S],
+                 rho=np.asarray(opt.rho)[:S], iter=np.asarray(opt._iter))
 
 
-def load_state(opt, path):
-    """Restore a checkpoint saved by ``save_state`` (shape-checked
-    against the REAL scenario count; mesh pads are re-filled by
-    replicating the last real row — pads ARE copies of the last
-    scenario, so its x̄/ρ rows are the consistent fill and pad W
-    carries no objective weight)."""
-    d = np.load(path)
+def install_state_arrays(opt, d):
+    """Install validated (W, x̄, x̄², ρ, iter) host blocks onto an
+    engine: shape-checked against the REAL scenario count, mesh pads
+    re-filled by replicating the last real row (pads ARE copies of the
+    last scenario, so its x̄/ρ rows are the consistent fill and pad W
+    carries no objective weight), engine-matched placement, factor
+    invalidation when rho moved. The ONE install body behind
+    ``load_state`` and the ckpt bundle resume
+    (mpisppy_tpu.ckpt.manager.resume_hub)."""
     S_real, K = _real_S(opt), opt.batch.K
     S = opt.batch.S
     for key in ("W", "xbar", "xsqbar", "rho"):
@@ -75,11 +84,31 @@ def load_state(opt, path):
     opt.xbar = place(pad(d["xbar"]))
     opt.xsqbar = place(pad(d["xsqbar"]))
     old_rho = np.asarray(opt.rho)
-    new_rho = pad(d["rho"])
+    new_rho = pad(np.asarray(d["rho"]))
     opt.rho = place(new_rho)
     opt._iter = int(d["iter"])
     if not np.allclose(old_rho, new_rho):
         opt.invalidate_factors()
+
+
+def load_state(opt, path):
+    """Restore a checkpoint saved by ``save_state``. Payloads pass the
+    SAME load-side validation as checkpoint bundles
+    (ckpt.bundle.validate_state_arrays): non-finite blocks and absurd
+    iteration counters are rejected with a reasoned error and a
+    ``ckpt.rejected.<reason>`` counter instead of installing NaNs into
+    the prox center."""
+    from .. import obs
+    from ..ckpt.bundle import CheckpointError, validate_state_arrays
+
+    with np.load(path) as f:
+        raw = {k: np.asarray(f[k]) for k in f.files}
+    try:
+        d = validate_state_arrays(raw)
+    except CheckpointError as e:
+        obs.counter_add(f"ckpt.rejected.{e.reason}")
+        raise
+    install_state_arrays(opt, d)
 
 
 def _write_scen_csv(opt, path, arr):

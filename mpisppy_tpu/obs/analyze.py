@@ -347,6 +347,50 @@ def incumbent_summary(run: Run) -> dict | None:
     }
 
 
+def checkpoint_summary(run: Run) -> dict | None:
+    """Durable checkpoint activity (mpisppy_tpu.ckpt,
+    doc/fault_tolerance.md): ``ckpt.*`` counters summed across roles
+    (spoke warm-state writes land in spoke roles), the capture
+    trajectory, resume provenance, and rejected-bundle reasons. None
+    when checkpointing never ran — the section only renders for
+    checkpointing wheels."""
+    tot = {}
+    for role in run.metrics:
+        for k, v in run.counters(role).items():
+            if k.startswith("ckpt."):
+                tot[k] = tot.get(k, 0) + v
+    captures = run.of("ckpt.capture")
+    resumes = run.of("ckpt.resume")
+    rejected = run.of("ckpt.resume_rejected")
+    preempts = run.of("hub.preempted")
+    if not tot and not captures and not resumes and not rejected:
+        return None
+    rej_reasons = {}
+    for k, v in tot.items():
+        if k.startswith("ckpt.rejected."):
+            rej_reasons[k[len("ckpt.rejected."):]] = \
+                rej_reasons.get(k[len("ckpt.rejected."):], 0) + int(v)
+    for e in rejected:
+        rej_reasons.setdefault(e.get("reason"), 0)
+    last = captures[-1] if captures else {}
+    return {
+        "captures": int(tot.get("ckpt.captures", 0)) or len(captures),
+        "write_failed": int(tot.get("ckpt.write_failed", 0)),
+        "spoke_writes": int(tot.get("ckpt.spoke_writes", 0)),
+        "last_bundle": last.get("bundle"),
+        "last_iter": last.get("iter"),
+        "reasons": sorted({e.get("reason") for e in captures
+                           if e.get("reason")}),
+        "resumed": bool(resumes)
+        or bool(int(tot.get("ckpt.resumed", 0))),
+        "resume": (resumes[-1] if resumes else None),
+        "spoke_resumed": int(tot.get("ckpt.spoke_resumed", 0)),
+        "rejected": rej_reasons,
+        "preempted": bool(preempts)
+        or bool(run.counters().get("hub.preempted")),
+    }
+
+
 def bound_flow_summary(run: Run) -> dict | None:
     """Per-spoke bound-flow ledger + verdict — the live-plane answer to
     ROADMAP item 1's diagnostic question ("is the Lagrangian spoke
@@ -710,6 +754,32 @@ def render_report(run: Run) -> str:
                  + ("" if dp == 0 else
                     "  [NONZERO — steady-state sharded iterations "
                     "should not device_put]"))
+        L.append("")
+
+    ck = checkpoint_summary(run)
+    if ck is not None:
+        L.append("== checkpoint ==")
+        L.append(f"captures {ck['captures']} "
+                 f"(reasons {ck['reasons'] or ['-']})  spoke-state "
+                 f"writes {ck['spoke_writes']}  write failures "
+                 f"{ck['write_failed']}")
+        if ck.get("last_bundle"):
+            L.append(f"last bundle: {ck['last_bundle']} "
+                     f"(iter {ck['last_iter']})")
+        if ck["resumed"]:
+            r = ck.get("resume") or {}
+            L.append(f"RESUMED from {r.get('bundle')} "
+                     f"(iter {r.get('iter')}, outer "
+                     f"{_fmt(r.get('outer'))}, inner "
+                     f"{_fmt(r.get('inner'))}); spoke resumes "
+                     f"{ck['spoke_resumed']}")
+        if ck["rejected"]:
+            L.append("rejected bundles: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(ck["rejected"].items()))
+                + " (cold start fallback)")
+        if ck["preempted"]:
+            L.append("PREEMPTED: SIGTERM notice handled — final "
+                     "bundle captured before terminate")
         L.append("")
 
     inc = incumbent_summary(run)
@@ -1150,6 +1220,7 @@ def main(argv=None) -> int:
                             if k != "entries"},
                 "sharding": sharding_summary(run),
                 "incumbent": incumbent_summary(run),
+                "checkpoint": checkpoint_summary(run),
                 "faults": fault_summary(run),
                 "bound_flow": (bf := bound_flow_summary(run)),
                 "invariants": [

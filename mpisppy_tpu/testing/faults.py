@@ -24,22 +24,36 @@ Fault-plan schema (dict, JSON string, or path to a JSON file)::
          {"action": "crash",  "after_s": 3.0},        # ... on a timer
          {"action": "hang",   "after_s": 2.0},        # stop responding
          {"action": "delay_hello", "seconds": 5.0},   # late handshake
+         {"action": "preempt", "at_update": 2},       # SIGTERM self
          {"action": "corrupt", "from_update": 2,      # poison payloads
           "value": "inf"}                             # inf|nan|garbage|float
-       ]}}
+       ]},
+     "hub": [                         # the HUB process (wheel launcher)
+       {"action": "preempt", "at_iteration": 5}       # preemption notice
+     ]}
 
 Triggers: ``at_update`` fires on exactly the Nth ``spoke_to_hub``
 publish (1-based); ``from_update`` on every publish >= N; ``after_s``
-on the first poll/publish after that many seconds from install. A spec
-may carry ``gen`` (default 0): faults apply only to that incarnation of
-the spoke, so a respawned replacement (gen 1) runs clean unless the
-plan says otherwise — the property the respawn tests rely on.
+on the first poll/publish after that many seconds from install;
+``at_iteration`` (hub specs) on the first termination check at that
+engine iteration. A spec may carry ``gen`` (default 0): faults apply
+only to that incarnation of the spoke, so a respawned replacement
+(gen 1) runs clean unless the plan says otherwise — the property the
+respawn tests rely on.
 
 ``crash`` fires *before* the write (the poisoned value never lands);
-``corrupt`` replaces the payload and lets the write proceed.
-``garbage`` corruption values are drawn from a RandomState keyed on
-(seed, spoke index, update number) — deterministic across runs and
-processes.
+``preempt`` delivers SIGTERM to the process' own pid — the preemption
+notice: a checkpointing wheel's handler captures a final bundle and
+terminates cleanly (doc/fault_tolerance.md), a bare spoke dies and is
+respawned warm. ``corrupt`` replaces the payload and lets the write
+proceed. ``garbage`` corruption values are drawn from a RandomState
+keyed on (seed, spoke index, update number) — deterministic across
+runs and processes.
+
+Hub-side plans (the ``"hub"`` key) are installed by
+``spin_the_wheel_processes`` when the ``MPISPPY_TPU_FAULT_PLAN`` env
+var is set — same explicit-activation contract as the spoke side: the
+clean path never imports this module.
 """
 
 from __future__ import annotations
@@ -51,8 +65,11 @@ import time
 
 import numpy as np
 
-_ACTIONS = ("crash", "hang", "delay_hello", "corrupt")
+_ACTIONS = ("crash", "hang", "delay_hello", "corrupt", "preempt")
 _TRIGGERS = ("at_update", "from_update", "after_s", "seconds")
+# hub specs trade the publish-count triggers for the iteration one:
+# the hub has no spoke_to_hub, spokes have no engine iteration
+_HUB_TRIGGERS = ("at_iteration", "after_s")
 _VALUES = ("inf", "-inf", "nan", "garbage")
 
 
@@ -71,17 +88,17 @@ def validate_plan(plan: dict) -> dict:
     """Schema check (fail at install time, not mid-wheel)."""
     if not isinstance(plan, dict):
         raise ValueError(f"fault plan must be a dict, got {type(plan)}")
-    unknown = set(plan) - {"seed", "spokes"}
+    unknown = set(plan) - {"seed", "spokes", "hub"}
     if unknown:
         raise ValueError(f"unknown fault-plan keys {sorted(unknown)}")
-    for idx, specs in (plan.get("spokes") or {}).items():
-        int(idx)            # keys must be spoke indices
+
+    def _check_specs(specs, triggers):
         for sp in specs:
             act = sp.get("action")
             if act not in _ACTIONS:
                 raise ValueError(f"unknown fault action {act!r}; known: "
                                  f"{_ACTIONS}")
-            bad = set(sp) - {"action", "value", "gen", *_TRIGGERS}
+            bad = set(sp) - {"action", "value", "gen", *triggers}
             if bad:
                 raise ValueError(f"unknown fault-spec keys {sorted(bad)} "
                                  f"in {sp}")
@@ -90,6 +107,11 @@ def validate_plan(plan: dict) -> dict:
                     and not isinstance(v, (int, float)) and v not in _VALUES:
                 raise ValueError(f"corrupt value {v!r}; known: {_VALUES} "
                                  "or a number")
+
+    for idx, specs in (plan.get("spokes") or {}).items():
+        int(idx)            # keys must be spoke indices
+        _check_specs(specs, _TRIGGERS)
+    _check_specs(plan.get("hub") or [], _HUB_TRIGGERS)
     return plan
 
 
@@ -131,6 +153,15 @@ class FaultInjector:
         os.kill(os.getpid(), signal.SIGKILL)
         os._exit(137)           # unreachable unless SIGKILL is blocked
 
+    def _preempt(self):
+        """The preemption notice: SIGTERM to our own pid. A process
+        with the checkpointing handler installed (the hub — see
+        utils/multiproc) captures a final bundle and terminates
+        cleanly; a handler-less spoke child dies and is respawned
+        warm. Unlike _die, execution CONTINUES after a handled
+        signal — the wheel winds down through its normal exit path."""
+        os.kill(os.getpid(), signal.SIGTERM)
+
     def _hang(self):
         while True:             # ignores the kill signal on purpose
             time.sleep(3600.0)
@@ -168,6 +199,10 @@ class FaultInjector:
                                            or self._timed_out(s)):
                 self._die()
         for s in self.specs:
+            if s["action"] == "preempt" and (self._update_hit(s)
+                                             or self._timed_out(s)):
+                self._preempt()
+        for s in self.specs:
             if s["action"] == "hang" and self._update_hit(s):
                 self._hang()
         for s in self.specs:
@@ -182,6 +217,9 @@ class FaultInjector:
         for s in self.specs:
             if s["action"] == "crash" and self._timed_out(s):
                 self._die()
+        for s in self.specs:
+            if s["action"] == "preempt" and self._timed_out(s):
+                self._preempt()
         for s in self.specs:
             if s["action"] == "hang" and self._timed_out(s):
                 self._hang()
@@ -205,3 +243,52 @@ class FaultInjector:
         spoke.spoke_to_hub = _put
         spoke.got_kill_signal = _poll
         return self
+
+    # -- hub-side triggers --
+    def on_iteration(self, it):
+        """Called once per hub termination check with the engine's
+        current iteration: ``at_iteration`` / ``after_s`` triggers for
+        HUB specs (preempt = the deterministic preemption notice the
+        checkpoint-resume tests drive; crash/hang for completeness).
+        Each spec fires at most once — termination checks repeat at
+        the same iteration."""
+        fired = getattr(self, "_fired", None)
+        if fired is None:
+            fired = self._fired = set()
+        for i, s in enumerate(self.specs):
+            if i in fired:
+                continue
+            at = s.get("at_iteration")
+            hit = (at is not None and it is not None
+                   and int(it) >= int(at)) or self._timed_out(s)
+            if not hit:
+                continue
+            fired.add(i)
+            if s["action"] == "crash":
+                self._die()
+            elif s["action"] == "preempt":
+                self._preempt()
+            elif s["action"] == "hang":
+                self._hang()
+
+
+def install_hub_faults(hub, spec):
+    """Wrap ``hub.determine_termination`` with the plan's ``"hub"``
+    specs (instance attribute only — the class stays untouched, same
+    discipline as the spoke install). Returns the injector, or None
+    when the plan carries no hub specs. Activated exclusively by
+    ``spin_the_wheel_processes`` under the MPISPPY_TPU_FAULT_PLAN env
+    var — the deterministic harness's handle on the WHEEL process."""
+    plan = validate_plan(_load_spec(spec))
+    specs = plan.get("hub") or []
+    if not specs:
+        return None
+    inj = FaultInjector(specs, index=-1, seed=plan.get("seed", 0))
+    orig = hub.determine_termination
+
+    def _check():
+        inj.on_iteration(getattr(hub.opt, "_iter", None))
+        return orig()
+
+    hub.determine_termination = _check
+    return inj

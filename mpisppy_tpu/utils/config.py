@@ -202,6 +202,19 @@ class RunConfig:
     # heartbeat_timeout, max_respawns, respawn_backoff(+_cap),
     # max_rejections, poll_interval, crossed_bound_tol
     supervisor: dict = field(default_factory=dict)
+    # ---- durable checkpoints + resume (mpisppy_tpu.ckpt) ----
+    # checkpoint_dir arms hub-owned run-state bundles (periodic from
+    # the termination-check path; forced on watchdog fire and SIGTERM
+    # — the preemption notice), per-spoke warm-state files the
+    # supervisor hands back to respawned incarnations, and LATEST/
+    # retention bookkeeping. resume_from relaunches the wheel from a
+    # bundle (or a checkpoint dir, resolved through LATEST); a
+    # corrupt/mismatched bundle falls back to cold start with a
+    # reasoned event, never a crash (doc/fault_tolerance.md).
+    checkpoint_dir: str | None = None
+    checkpoint_interval: float = 30.0
+    checkpoint_keep: int = 3
+    resume_from: str | None = None
     # ---- scenario-axis sharding (doc/sharding.md) ----
     # mesh over the local (or, with ``coordinator``, global) device
     # set for the hub engine: None = single-device; 0 = all devices;
@@ -246,6 +259,11 @@ class RunConfig:
         if self.spoke_ready_timeout <= 0 or self.join_timeout <= 0:
             raise ValueError("spoke_ready_timeout and join_timeout must "
                              "be positive")
+        if self.checkpoint_interval <= 0:
+            raise ValueError("checkpoint_interval must be positive "
+                             "(seconds between periodic bundles)")
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
         from ..cylinders.supervisor import KNOWN_OPTIONS
         bad = set(self.supervisor) - set(KNOWN_OPTIONS)
         if bad:

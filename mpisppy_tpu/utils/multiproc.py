@@ -171,6 +171,13 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
     import numpy as np
     spoke.my_window.put(np.full(spoke.local_window_length(), np.nan))
     try:
+        # warm resume (mpisppy_tpu.ckpt): a spoke handed a
+        # ``resume_state`` option re-publishes its checkpointed best
+        # bound as its FIRST publish — after the hello (the hub's
+        # readiness gate) and before main() recomputes anything, so a
+        # respawned incarnation's first bound is never worse than its
+        # predecessor's best
+        spoke.resume_publish()
         spoke.main()
         spoke.finalize()
     finally:
@@ -226,6 +233,19 @@ def _spawn_one_spoke(cfg: RunConfig, i, run_id, ctx, S, K, f32, tdir,
     from dataclasses import asdict
 
     sp = cfg.spokes[i]
+    sp_dict = asdict(sp)
+    if cfg.checkpoint_dir or cfg.resume_from:
+        # checkpoint/resume wiring (mpisppy_tpu.ckpt): where this
+        # incarnation WRITES its warm state, and — for respawns
+        # (gen > 0, the supervisor path) or a --resume-from launch —
+        # the state file it resumes FROM. This is what turns the
+        # supervisor's respawn into "resume the spoke": generation N
+        # starts from the freshest state generation N-1 persisted.
+        from ..ckpt.spoke_state import spoke_resume_options
+        for k, v in spoke_resume_options(
+                cfg.checkpoint_dir, cfg.resume_from, i, sp.kind,
+                gen=gen).items():
+            sp_dict["options"].setdefault(k, v)
     proxy = _spoke_proxy(sp.kind, run_id, i, S, K, create=True, gen=gen)
     # explicit telemetry propagation (not only the inherited env var):
     # each child captures into the shared run dir under its own role
@@ -234,7 +254,7 @@ def _spawn_one_spoke(cfg: RunConfig, i, run_id, ctx, S, K, f32, tdir,
     role = f"spoke{i}-{sp.kind}" + (f"-r{gen}" if gen else "")
     telemetry = {"out_dir": tdir, "role": role, "index": i, "gen": gen}
     p = ctx.Process(target=_spoke_worker,
-                    args=(cfg.to_dict(), asdict(sp),
+                    args=(cfg.to_dict(), sp_dict,
                           *_spoke_window_names(run_id, i, gen), f32,
                           telemetry),
                     daemon=True)
@@ -336,6 +356,7 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
     proxies, procs, owned = [], [], []
     supervisor = None
     hub = None
+    prev_sigterm = None
     try:
         proxies, procs, owned = spawn_spoke_processes(cfg, run_id, ctx,
                                                       S, K, f32)
@@ -361,6 +382,31 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
         supervisor.attach(hub)
         if cfg.wheel_deadline:
             supervisor.start_watchdog(cfg.wheel_deadline)
+        # deterministic hub-side faults (testing/faults.py): the
+        # harness can preempt (SIGTERM) or crash the HUB process at a
+        # named iteration, the way spoke plans crash spokes. Import
+        # gated on the env var — the clean path imports nothing from
+        # mpisppy_tpu.testing (tests/test_faults.py asserts it).
+        hub_fault_spec = os.environ.get("MPISPPY_TPU_FAULT_PLAN")
+        if hub_fault_spec:
+            from ..testing.faults import install_hub_faults
+            install_hub_faults(hub, hub_fault_spec)
+        # the preemption notice path (doc/fault_tolerance.md): with
+        # checkpointing armed, SIGTERM forces one final bundle +
+        # nonblocking telemetry flush + clean terminate (bench.py's
+        # signal-safe flush pattern) instead of losing the whole
+        # optimization state. Handler restored on every exit path
+        # (outermost finally).
+        if cfg.checkpoint_dir:
+            import signal as _signal
+
+            def _on_sigterm(signum, frame):
+                hub.handle_preemption("sigterm")
+            try:
+                prev_sigterm = _signal.signal(_signal.SIGTERM,
+                                              _on_sigterm)
+            except ValueError:
+                prev_sigterm = None     # not the main thread
         wait_spoke_hellos(cfg, proxies, procs, spoke_ready_timeout,
                           hub=hub)
         try:
@@ -416,5 +462,8 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
                 p.join(timeout=10.0)
         raise
     finally:
+        if prev_sigterm is not None:
+            import signal as _signal
+            _signal.signal(_signal.SIGTERM, prev_sigterm)
         for w in owned:
             w.close(unlink=True)

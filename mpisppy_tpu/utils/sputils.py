@@ -151,6 +151,12 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None,
 
     def _run_spoke(i, sp):
         try:
+            # warm resume (mpisppy_tpu.ckpt): a spoke built with a
+            # ``resume_state`` option re-publishes its checkpointed
+            # best bound first — same contract as the process
+            # launcher's post-hello hook (utils/multiproc)
+            if hasattr(sp, "resume_publish"):
+                sp.resume_publish()
             sp.main()
         except BaseException as e:  # surface spoke crashes to the caller
             spoke_errors[i] = e

@@ -67,6 +67,19 @@ def build_batch_for(cfg: RunConfig):
     return batch
 
 
+def ckpt_fingerprint(cfg: RunConfig) -> str:
+    """The run-identity fingerprint stamped into checkpoint bundles
+    (ckpt/bundle.config_fingerprint): a bundle only resumes into a
+    wheel with the same model family, scenario count, model kwargs,
+    bundling, and hub algorithm — anything else would install
+    foreign (or shape-mismatched) state."""
+    from ..ckpt.bundle import config_fingerprint
+    return config_fingerprint({
+        "model": cfg.model, "num_scens": cfg.num_scens,
+        "model_kwargs": cfg.model_kwargs,
+        "num_bundles": cfg.num_bundles, "hub": cfg.hub})
+
+
 def hub_dict(cfg: RunConfig, batch=None):
     """ref. vanilla.py:54 ph_hub (+ aph/lshaped variants). ``batch``:
     optionally a prebuilt batch shared across cylinders (engines never
@@ -94,6 +107,20 @@ def hub_dict(cfg: RunConfig, batch=None):
     if "crossed_bound_tol" in cfg.supervisor:
         hub_kwargs["options"]["crossed_bound_tol"] = \
             cfg.supervisor["crossed_bound_tol"]
+    if cfg.checkpoint_dir or cfg.resume_from:
+        # durable run-state checkpoints + resume (mpisppy_tpu.ckpt):
+        # the hub owns capture; resume installs before iter 0. The
+        # fingerprint makes a bundle from a different configuration
+        # refuse cleanly at load.
+        if cfg.checkpoint_dir:
+            hub_kwargs["options"]["checkpoint_dir"] = cfg.checkpoint_dir
+            hub_kwargs["options"]["checkpoint_interval"] = \
+                cfg.checkpoint_interval
+            hub_kwargs["options"]["checkpoint_keep"] = cfg.checkpoint_keep
+        if cfg.resume_from:
+            hub_kwargs["options"]["resume_from"] = cfg.resume_from
+        hub_kwargs["options"]["checkpoint_fingerprint"] = \
+            ckpt_fingerprint(cfg)
 
     cross = any(sp.kind == "cross_scenario" for sp in cfg.spokes)
     if cfg.hub == "ph":
@@ -214,5 +241,16 @@ def wheel_dicts(cfg: RunConfig):
                               "hub": cfg.hub,
                               "spokes": [sp.kind for sp in cfg.spokes]})
     batch = build_batch_for(cfg)
-    return hub_dict(cfg, batch=batch), \
-        [spoke_dict(cfg, sp, batch=batch) for sp in cfg.spokes]
+    spoke_ds = [spoke_dict(cfg, sp, batch=batch) for sp in cfg.spokes]
+    if cfg.checkpoint_dir or cfg.resume_from:
+        # per-spoke checkpoint/resume wiring needs the spoke INDEX
+        # (file naming), which spoke_dict alone never sees; the
+        # process launcher does the same injection per spawn
+        # (utils/multiproc._spawn_one_spoke, generation-aware)
+        from ..ckpt.spoke_state import spoke_resume_options
+        for i, (sp, sd) in enumerate(zip(cfg.spokes, spoke_ds)):
+            for k, v in spoke_resume_options(
+                    cfg.checkpoint_dir, cfg.resume_from, i,
+                    sp.kind).items():
+                sd["opt_kwargs"]["options"].setdefault(k, v)
+    return hub_dict(cfg, batch=batch), spoke_ds

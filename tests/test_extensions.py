@@ -256,6 +256,70 @@ def test_xbar_csv_roundtrips_multistage_rows(tmp_path):
     assert np.allclose(np.asarray(ph.xbar), xbar0, atol=1e-12)
 
 
+def test_resume_trajectory_matches_uninterrupted(tmp_path):
+    """ISSUE 10 satellite: checkpoint at iter k, resume in a FRESH
+    engine, and the continued trajectory matches the uninterrupted
+    run. Not bitwise — the resumed engine's warm iter-0 pass leaves
+    different QP warm-start states than the uninterrupted engine
+    carries at iter k — but the solves converge to subproblem_eps, so
+    the (W, x̄) trajectory agrees to solver tolerance."""
+    k, extra = 3, 3
+    full = make_ph(iters=k + extra, convthresh=-1.0)
+    full.ph_main(finalize=False)
+
+    ph_a = make_ph(iters=k, convthresh=-1.0)
+    ph_a.ph_main(finalize=False)
+    ck = str(tmp_path / "k.npz")
+    wxbar_io.save_state(ph_a, ck)
+
+    ph_b = make_ph(iters=extra, convthresh=-1.0)
+    wxbar_io.load_state(ph_b, ck)
+    assert ph_b._iter == k
+    ph_b._warm_started = True
+    ph_b._warm_started_xbar = True
+    ph_b.ph_main(finalize=False)
+
+    scale = float(np.abs(np.asarray(full.xbar)).max())
+    np.testing.assert_allclose(np.asarray(ph_b.xbar),
+                               np.asarray(full.xbar),
+                               atol=1e-4 * scale, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ph_b.W), np.asarray(full.W),
+                               atol=1e-4 * scale, rtol=1e-5)
+
+
+def test_resume_trajectory_sharded_unsharded_band(tmp_path):
+    """The sharded side of the resume-determinism satellite: a
+    checkpoint captured by an UNSHARDED run at iter k, resumed in a
+    SHARDED (mesh-padded) engine, lands in a tolerance band of the
+    unsharded continuation — psum reduction order and pad rows change
+    the floating-point composition, not the trajectory."""
+    from mpisppy_tpu.parallel.mesh import make_mesh
+
+    mk = lambda: build_batch(farmer.scenario_creator,
+                             farmer.make_tree(4))
+    opts = {"defaultPHrho": 1.0, "convthresh": -1.0,
+            "subproblem_max_iter": 2000, "subproblem_eps": 1e-7}
+    ph_a = PH(mk(), {**opts, "PHIterLimit": 2})
+    ph_a.ph_main(finalize=False)
+    ck = str(tmp_path / "k.npz")
+    wxbar_io.save_state(ph_a, ck)
+
+    def resume(mesh):
+        ph = PH(mk(), {**opts, "PHIterLimit": 2}, mesh=mesh)
+        wxbar_io.load_state(ph, ck)
+        ph._warm_started = True
+        ph._warm_started_xbar = True
+        ph.ph_main(finalize=False)
+        S = getattr(ph, "_S_orig", ph.batch.S)
+        return np.asarray(ph.xbar)[:S]
+
+    plain = resume(None)
+    sharded = resume(make_mesh(2))           # pads 4 -> 4, 2 devices
+    scale = max(float(np.abs(plain).max()), 1.0)
+    np.testing.assert_allclose(sharded, plain, atol=5e-4 * scale,
+                               rtol=1e-4)
+
+
 def test_checkpoint_portable_between_sharded_and_unsharded(tmp_path):
     """ISSUE 6 review: checkpoints carry REAL scenarios only — a file
     written by a sharded (mesh-padded) run loads into an unsharded run

@@ -50,7 +50,7 @@ BENCH_ARGS = ["farmer", "--num-scens", "3", "--max-iterations", "5",
               "--rel-gap", "1e-6"]
 
 
-def run_bench(out_dir: str) -> int:
+def run_bench(out_dir: str, extra_args=()) -> int:
     """One small farmer wheel with telemetry into ``out_dir`` — a
     subprocess so the gate script itself never imports jax and every
     invocation pays the same cold-start shape the golden did."""
@@ -58,9 +58,28 @@ def run_bench(out_dir: str) -> int:
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)   # ours, explicitly
     cmd = [sys.executable, "-m", "mpisppy_tpu", *BENCH_ARGS,
-           "--telemetry-dir", out_dir]
+           *extra_args, "--telemetry-dir", out_dir]
     r = subprocess.run(cmd, cwd=REPO, env=env, timeout=600)
     return r.returncode
+
+
+def check_checkpoints(ckpt_dir: str) -> int:
+    """The ISSUE 10 acceptance rider: the gated bench ran with
+    ``--checkpoint-dir``, so checkpoint capture is INSIDE the compared
+    run — any gate-sync or steady-state device_put it added fails the
+    ``analyze --compare`` gate below (the PR 6 acceptance contract).
+    Here we assert the capture itself worked: a LATEST-pointed bundle
+    exists and passes load-side validation."""
+    from mpisppy_tpu.ckpt.bundle import CheckpointError, load_bundle
+    try:
+        manifest, arrays, _ = load_bundle(ckpt_dir)
+    except CheckpointError as e:
+        print(f"regression_gate: checkpoint capture broken: {e}")
+        return 1
+    print(f"regression_gate: checkpoint bundle ok (iter "
+          f"{manifest.get('iter')}, {len(manifest.get('files') or {})} "
+          "members)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -111,13 +130,22 @@ def main(argv=None) -> int:
 
     fresh = args.keep or tempfile.mkdtemp(prefix="regression_gate_")
     try:
-        rc = run_bench(fresh)
+        # the fresh side runs WITH checkpoint capture armed (the
+        # golden stays minimal): checkpoint writes ride the compared
+        # run, so a capture-induced gate sync / device_put / phase
+        # blowup trips the same compare gate as any other regression
+        ckpt_dir = os.path.join(fresh, "ckpt")
+        rc = run_bench(fresh, extra_args=["--checkpoint-dir", ckpt_dir,
+                                          "--checkpoint-interval", "1"])
         if rc != 0:
             print(f"regression_gate: bench run failed (rc {rc})")
             return rc or 1
         # analyze is jax-free — import it here, after the bench
         # subprocess did the heavy lifting
         sys.path.insert(0, REPO)
+        rc = check_checkpoints(ckpt_dir)
+        if rc != 0:
+            return rc
         from mpisppy_tpu.obs.analyze import main as analyze_main
         rc = analyze_main(["--compare", args.golden, fresh,
                            "--threshold", str(args.threshold),
