@@ -303,6 +303,7 @@ def _ph_step(qp_state, factors, data, c, c0, P0, prob, xbar_w, memberships,
             # device wait would otherwise escape the lap anatomy
             # entirely — it lands at the caller's float(conv) sync,
             # outside every phase
+            # lint: ok[SYNC001] phase honesty: the fused wait must land inside the solve lap (see comment above)
             jax.block_until_ready(qp_state.pri_rel)
         lap("solve")
     wmask = None if wscale is None else wscale > 0
@@ -554,7 +555,7 @@ class PHBase(SPBase):
             # shared-structure batch: the prox diagonal must stay shared for
             # the single-factor path, which it is whenever rho is uniform
             # across scenarios (the default; rho setters are per-variable)
-            rho_np = np.asarray(self.rho)
+            rho_np = np.asarray(self.rho)   # lint: ok[SYNC001] factor-(re)build path: prox diagonal built host-side once per invalidation, not per solve
             if (rho_np == rho_np[:1]).all():
                 P = d.P_diag.at[self.nonant_idx].add(
                     jnp.asarray(rho_np[0], self.dtype))
@@ -1088,6 +1089,7 @@ class PHBase(SPBase):
             # chunk is already enqueued — blocking here costs no
             # cross-chunk pipelining and adds no transfer; the gate
             # still pays its one D2H below.
+            # lint: ok[SYNC001] phase honesty for fused plans: every chunk already enqueued, the wait adds no serialization (see comment above)
             jax.block_until_ready([rec[0].pri_rel
                                    for rec in solved_chunks])
             if obs.enabled():
@@ -1118,10 +1120,12 @@ class PHBase(SPBase):
         if pipeline:
             # np.array (not asarray): retry/hospital row writebacks need
             # a writable host matrix, and jax exports read-only views
+            # lint: ok[SYNC001] THE stacked-residual gate: ONE D2H per iteration for the whole chunk chain (ph.gate_syncs)
             pri_host = np.array(stacked_residuals(
                 [rec[0] for rec in solved_chunks]))
             gate_syncs += 1
         else:
+            # lint: ok[SYNC001] sequential opt-out: the documented one-blocking-sync-per-chunk path (gate_syncs books each)
             pri_host = np.stack([np.asarray(rec[0].pri_rel)
                                  for rec in solved_chunks])
             gate_syncs += len(solved_chunks)
@@ -1153,7 +1157,7 @@ class PHBase(SPBase):
                 f"(every {readmit} solves)", count=nb, every=readmit)
         no_retry = self._chunk_no_retry.setdefault(key, set())
         for ci, rec in enumerate(solved_chunks):
-            m = float(pri_host[ci].max())
+            m = float(pri_host[ci].max())   # lint: ok[SYNC001] host numpy, synced once at the gate read above
             is_nan = not np.isfinite(m)
             # the blacklist stops repeated retries of a genuinely hard
             # chunk, but NaN iterates MUST always be replaced — storing
@@ -1186,11 +1190,11 @@ class PHBase(SPBase):
                                          + 4 * kw["tail_iter"], 1500))
             st2, x2, yA2, yB2 = _solver_call(fac_c, rec[4], rec[5],
                                              st_r, **kw_r)
-            pri2 = np.asarray(st2.pri_rel)      # exceptional-path sync
+            pri2 = np.asarray(st2.pri_rel)   # lint: ok[SYNC001] exceptional-path retry sync, booked as its own gate_sync
             gate_syncs += 1
             if obs.enabled():
                 obs.counter_add("xfer.d2h_bytes", pri2.nbytes)
-            m2 = float(pri2.max())
+            m2 = float(pri2.max())   # lint: ok[SYNC001] host numpy from the retry read
             obs.counter_add("ph.chunk_retries")
             obs.event("ph.chunk_retry",
                       {"chunk": ci, "nan": is_nan, "pri_rel_before": m,
@@ -1245,10 +1249,11 @@ class PHBase(SPBase):
             for ci, (idx_c, real) in enumerate(slices):
                 pr = pri_host[ci][:real]
                 for r in np.flatnonzero(~(pr <= thr)):
+                    # lint: ok[SYNC001] trace-note path: runs only when a trace consumer is active (guard above)
                     g = int(np.asarray(idx_c)[r])
                     if g >= self._S_orig:
                         continue   # zero-probability mesh pad rows
-                    standing.append((g, float(pr[r])))
+                    standing.append((g, float(pr[r])))   # lint: ok[SYNC001] host numpy slice of the gate read
             if standing:
                 g_w, pr_w = max(standing, key=lambda t: t[1])
                 when = (f"re-admission in {readmit - calls % readmit} "
@@ -1330,6 +1335,7 @@ class PHBase(SPBase):
                     slot_slices=self.slot_bounds)
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
+            # lint: ok[SYNC001] THE per-iteration convergence scalar readback — the one designed sync (doc/pipelining.md)
             self.conv = float(conv)
             obs.gauge_set("ph.conv", self.conv)
         self._last_base_obj = cat["base"]
@@ -1642,12 +1648,13 @@ class PHBase(SPBase):
         # device): a shard that already fits one chunk runs the fused
         # SPMD step; larger shards run the sharded chunked loop
         sh = self._shard_ops
-        chunked = bool(chunk) and (chunk < sh.shard_size if sh is not None
-                                   else chunk < self.batch.S)
+        chunked = chunk > 0 and (chunk < sh.shard_size if sh is not None
+                                 else chunk < self.batch.S)
         if chunked:
             out = self._solve_loop_chunked(chunk, w_on, prox_on, update,
                                            fixed)
             if self._timing:
+                # lint: ok[SYNC001] opt-in timing sync (report_timing), off by default
                 jax.block_until_ready(self.x)
                 self._solve_times.setdefault(
                     (bool(w_on), bool(prox_on), bool(fixed)), []).append(
@@ -1714,6 +1721,7 @@ class PHBase(SPBase):
         if update:
             self.xbar, self.xsqbar = xbar_new, xsqbar_new
             self.W_new = W_new
+            # lint: ok[SYNC001] THE per-iteration convergence scalar readback — the one designed sync (doc/pipelining.md)
             self.conv = float(conv)
             obs.gauge_set("ph.conv", self.conv)
         self._last_base_obj = base_obj
@@ -1722,6 +1730,7 @@ class PHBase(SPBase):
         if self._timing:
             # the sync exists only to time honestly; without the option it
             # is skipped so host work keeps overlapping device compute
+            # lint: ok[SYNC001] opt-in timing sync (report_timing), off by default
             jax.block_until_ready(x)
             self._solve_times.setdefault(
                 (bool(w_on), bool(prox_on), bool(fixed)), []).append(

@@ -489,10 +489,17 @@ class Hub(SPCommunicator):
         sup = self.supervisor
         spokes = []
         flow = self.bound_flow_status()
+        # ledger reads on the HTTP thread take the same lock the hub
+        # thread's mutations do (graft-lint LOCK001 audit: this was the
+        # one _spoke_flow access outside the PR 8 discipline — benign
+        # under the GIL, but bound_flow_status locks its reads and the
+        # snapshot should not be the exception)
+        with self._flow_lock:
+            gens = [f["gen"] for f in self._spoke_flow]
         for i, sp in enumerate(self.spokes):
             cls = getattr(sp, "_spoke_cls", type(sp))
             ent = {"index": i, "spoke": cls.__name__,
-                   "state": "running", "gen": self._spoke_flow[i]["gen"],
+                   "state": "running", "gen": gens[i],
                    "crashes": 0, "rejections": 0,
                    **flow.get(f"spoke{i}", {})}
             if sup is not None and i < len(sup.health):

@@ -641,6 +641,40 @@ def _fmt(v, nd=4):
     return str(v)
 
 
+def lint_summary(run: Run) -> dict | None:
+    """The graft-lint stamp (ISSUE 12): when the telemetry dir carries
+    a ``lint.json`` report (``python -m tools.lint --out <dir>/lint.json``
+    — tools/regression_gate.py writes one beside the fresh bench), the
+    report gets a one-line lint-status stamp. None when absent."""
+    p = os.path.join(run.path, "lint.json")
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p, encoding="utf-8") as f:
+            rep = json.load(f)
+    except (OSError, ValueError):
+        rep = None
+    if not isinstance(rep, dict):
+        # unreadable / torn / non-object payload: stamp it as such
+        # rather than aborting the whole run report
+        return {"status": "unreadable", "findings": None,
+                "suppressed": None, "files_checked": None}
+    findings = rep.get("findings") or []
+    return {"status": "clean" if not findings else "findings",
+            "findings": len(findings),
+            "suppressed": len(rep.get("suppressed") or []),
+            "files_checked": rep.get("files_checked")}
+
+
+def _lint_line(ls: dict) -> str:
+    if ls["status"] == "unreadable":
+        return "lint: lint.json present but unreadable"
+    head = ("clean" if ls["status"] == "clean"
+            else f"{ls['findings']} FINDING(S)")
+    return (f"lint: {head}  ({ls['files_checked']} files, "
+            f"{ls['suppressed']} suppressed) [lint.json]")
+
+
 def render_report(run: Run) -> str:
     L = []
     h = run.header
@@ -649,6 +683,9 @@ def render_report(run: Run) -> str:
     L.append(f"run_id {h.get('run_id')}  schema {run.schema}  "
              f"started {h.get('wall_time_iso')}  "
              f"roles [{', '.join(r or 'hub' for r in sorted(run.roles))}]")
+    ls = lint_summary(run)
+    if ls is not None:
+        L.append(_lint_line(ls))
     if isinstance(cfg, dict) and cfg.get("model"):
         L.append(f"model {cfg.get('model')}  "
                  f"num_scens {cfg.get('num_scens')}  "
@@ -1222,6 +1259,7 @@ def main(argv=None) -> int:
                 "incumbent": incumbent_summary(run),
                 "checkpoint": checkpoint_summary(run),
                 "faults": fault_summary(run),
+                "lint": lint_summary(run),
                 "bound_flow": (bf := bound_flow_summary(run)),
                 "invariants": [
                     {"name": n, "ok": ok, "detail": d, "severity": sv}

@@ -96,6 +96,11 @@ class KernelPlan:
     block_dtype: str = "f32"     # "f32" | "bf16" (effective)
     A_lo: object = None          # bulk-phase A_s operand (mixed/df32)
     bf16_err: float | None = None
+    # host copy of factors.sigma, read ONCE at prepare() time: the
+    # pallas block needs it as a compile-time constant, and reading it
+    # per solve call would put a scalar D2H on every chunk dispatch
+    # (graft-lint SYNC001 caught exactly that)
+    sigma_host: float | None = None
 
     def descriptor(self) -> dict:
         """The bench/telemetry kernel block."""
@@ -203,9 +208,15 @@ def prepare(factors, *, mode="auto", backend="reference",
         # pallas in this environment: the reference backend is the
         # default stand-in everywhere
         eff_backend = "reference"
+    # host copy of sigma, read once here (prepare is host+eager by
+    # contract) so the per-solve pallas launch never pays a scalar
+    # D2H; partial factor stubs (scope tests) simply carry None and
+    # fused_admm_block's direct-caller fallback covers them
+    sig = getattr(factors, "sigma", None)
     return KernelPlan(mode="fused", backend=eff_backend,
                       precision=precision, l_inv=use_linv,
-                      block_dtype=bdt, A_lo=A_lo, bf16_err=err)
+                      block_dtype=bdt, A_lo=A_lo, bf16_err=err,
+                      sigma_host=None if sig is None else float(sig))
 
 
 def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
@@ -253,7 +264,8 @@ def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
         # aliases the block's outputs plus the caller's factor/rho
         # buffers, exactly the ownership donate=True relinquishes.
         x_s, yA_s, yB_s, zA_s, zB_s, _, _ = pallas_kernel.fused_admm_block(
-            factors, data, q, state, n_steps=max_iter)
+            factors, data, q, state, n_steps=max_iter,
+            sigma=plan.sigma_host)
         st = state._replace(x=x_s, yA=yA_s, yB=yB_s, zA=zA_s, zB=zB_s)
         st, x, yA, yB = qp_solve(
             factors, data, q, st, donate=donate, max_iter=0,

@@ -150,16 +150,25 @@ def _block_call(A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
         Einv, Ebinv, Dinv_c, D, x, yA, yB, zA, zB)
 
 
-def fused_admm_block(factors, data, q, state, n_steps, interpret=None):
+def fused_admm_block(factors, data, q, state, n_steps, interpret=None,
+                     sigma=None):
     """Run ``n_steps`` fused ADMM iterations on the scaled problem
     (factors, data, q) from ``state``; returns (x, yA, yB, zA, zB,
     pri, dua) — SCALED iterates (the QPState carry convention) plus the
     unscaled residual maxima. Scaling comes from the shared
     qp_solver._scaled_problem helper so this block iterates the exact
-    problem _solve_impl would."""
+    problem _solve_impl would.
+
+    ``sigma``: the host float of ``factors.sigma`` (a compile-time
+    constant of the kernel). kernel_solve passes the plan's copy read
+    once at prepare() time; the fallback below is for direct callers
+    (parity tests) and pays one scalar D2H per block."""
     if interpret is None:
         # tier-1 coverage without a chip: interpret everywhere but TPU
         interpret = jax.default_backend() != "tpu"
+    if sigma is None:
+        # lint: ok[SYNC001] direct-caller fallback: kernel_solve passes the plan's host sigma (read once per factorization)
+        sigma = float(factors.sigma)
     g, l_s, u_s, lb_s, ub_s, csx, q_s = _scaled_problem(factors, data, q)
     rs = state.rho_scale
     rA = factors.rho_A * rs
@@ -173,7 +182,7 @@ def fused_admm_block(factors, data, q, state, n_steps, interpret=None):
     return _block_call(factors.A_s, F, factors.P_s, g, q_s,
                        l_s, u_s, lb_s, ub_s, rA, rB, Einv, Ebinv,
                        Dinv_c, factors.D, state.x, state.yA, state.yB,
-                       state.zA, state.zB, sigma=float(factors.sigma),
+                       state.zA, state.zB, sigma=sigma,
                        n_steps=int(n_steps), alpha=1.6,
                        interpret=bool(interpret),
                        l_inv_pair=l_inv_pair)

@@ -157,6 +157,7 @@ def _spoke_worker(cfg_dict, spoke_cfg_dict, hub_name, my_name, f32,
     fault_spec = opts.get("fault_plan") \
         or os.environ.get("MPISPPY_TPU_FAULT_PLAN")
     if fault_spec:
+        # lint: ok[PURE001] env/option-gated: reached only in children given an explicit fault plan (clean-path probe backstops)
         from ..testing.faults import FaultInjector
         injector = FaultInjector.from_spec(
             fault_spec,
@@ -389,6 +390,7 @@ def spin_the_wheel_processes(cfg: RunConfig, join_timeout=None, f32=False,
         # mpisppy_tpu.testing (tests/test_faults.py asserts it).
         hub_fault_spec = os.environ.get("MPISPPY_TPU_FAULT_PLAN")
         if hub_fault_spec:
+            # lint: ok[PURE001] env-gated: MPISPPY_TPU_FAULT_PLAN only — the clean path never imports testing (probe backstops)
             from ..testing.faults import install_hub_faults
             install_hub_faults(hub, hub_fault_spec)
         # the preemption notice path (doc/fault_tolerance.md): with

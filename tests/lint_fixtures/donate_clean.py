@@ -1,0 +1,33 @@
+# DONATE001 clean negatives: the healed rebind idiom, multi-line call
+# args, sibling branches, donations inside return statements, and
+# donate=False wrappers.
+
+
+def rebind_idiom(factors, data, q, state):
+    state, x, yA, yB = qp_solve(factors, data, q, state, donate=True)
+    return state, x             # rebound by the donating statement
+
+
+def multiline_args(factors, data, q, state, e_pri):
+    st, x, yA, yB = qp_solve(factors, data, q,
+                             state,
+                             donate=True,
+                             eps_abs=e_pri)
+    return st, x                # args inside the call span are fine
+
+
+def sibling_branches(factors, data, q, state, fused):
+    if fused:
+        st = _qp_solve_jit_donated(factors, data, q, state)
+    else:
+        st = plain_solve(factors, data, q, state)   # other arm: alive
+    return st
+
+
+def donation_in_return(factors, data, q, state):
+    return qp_solve(factors, data, q, state, donate=True)
+
+
+def no_donation(factors, data, q, state):
+    st, x, yA, yB = qp_solve(factors, data, q, state, donate=False)
+    return st, state.x          # copying twin: state stays alive

@@ -1,0 +1,7 @@
+# DONATE001 suppressed: a read-after-donate with a reasoned
+# suppression (e.g. the read is of a leaf the program never donates).
+
+
+def shared_factor_read(factors, data, q, state):
+    st, x, yA, yB = _qp_solve_jit_donated(factors, data, q, state)
+    return st, state.L   # lint: ok[DONATE001] fixture: L is the shared factor leaf, excluded from donation

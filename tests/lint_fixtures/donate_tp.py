@@ -1,0 +1,18 @@
+# DONATE001 true positives: reads after a donated-jit call consumed
+# the buffers. Callable names come from engine.DONATING_DEFAULT.
+
+
+def raw_twin(factors, data, q, state):
+    st, x, yA, yB = _qp_solve_jit_donated(factors, data, q, state)
+    return state.x + x          # state's buffers are deleted
+
+
+def wrapper_with_kwarg(factors, data, q, state):
+    st, x, yA, yB = qp_solve(factors, data, q, state, donate=True)
+    return st, state.pri_rel    # same bug through the wrapper
+
+
+def conditional_alias(factors, data, q, state, donate):
+    fn = _qp_solve_jit_donated if donate else _qp_solve_jit
+    st = fn(factors, data, q, state)
+    return st, state.x          # alias resolved conservatively

@@ -1,0 +1,28 @@
+# LOCK001 clean negatives: mutations under the right lock (direct and
+# through the ledger alias idiom), reads anywhere, ctor writes.
+import threading
+
+
+class Hub:
+    def __init__(self):
+        self._flow_lock = threading.Lock()
+        self._watchdog_lock = threading.Lock()
+        self._spoke_flow = [{}]
+        self._watchdog_fired = False
+
+    def guarded_writes(self, i):
+        with self._flow_lock:
+            flow = self._spoke_flow[i]
+            flow["produced"] += 1
+            self._spoke_flow[i]["last_seq"] = 7
+            self._spoke_flow.append({})
+
+    def guarded_once(self):
+        with self._watchdog_lock:
+            if self._watchdog_fired:
+                return
+            self._watchdog_fired = True
+
+    def reads_are_fine(self, i):
+        flow = self._spoke_flow[i]
+        return flow["produced"], self._watchdog_fired

@@ -1,0 +1,12 @@
+# LOCK001 suppressed: a mutation outside the lock with a reason
+# (e.g. provably single-threaded setup before the server starts).
+import threading
+
+
+class Hub:
+    def __init__(self):
+        self._flow_lock = threading.Lock()
+        self._spoke_flow = []
+
+    def install_spokes(self, n):
+        self._spoke_flow = [{} for _ in range(n)]   # lint: ok[LOCK001] fixture: runs before the status server thread starts

@@ -1,0 +1,24 @@
+# LOCK001 true positives: mutating HTTP-shared hub state outside its
+# lock (attribute map from engine.LOCK_GUARDS_DEFAULT).
+import threading
+
+
+class Hub:
+    def __init__(self):
+        self._flow_lock = threading.Lock()
+        self._watchdog_lock = threading.Lock()
+        self._spoke_flow = [{}]
+        self._watchdog_fired = False     # ctor is exempt
+
+    def unlocked_ledger_write(self, i):
+        self._spoke_flow[i]["produced"] = 1      # subscript store
+
+    def unlocked_alias_mutation(self, i):
+        flow = self._spoke_flow[i]
+        flow["consumed"] += 1                    # alias augassign
+
+    def unlocked_method_mutation(self):
+        self._spoke_flow.append({})              # mutating call
+
+    def unlocked_once_guard(self):
+        self._watchdog_fired = True              # attribute store

@@ -1,0 +1,9 @@
+# PURE001 true positive (clean-path half): a module under
+# mpisppy_tpu/ importing the testing package, absolutely and
+# relatively, with no gate.
+from mpisppy_tpu.testing import faults
+from .testing.faults import FaultInjector
+
+
+def use():
+    return faults, FaultInjector

@@ -1,0 +1,7 @@
+# PURE001 clean negative: modules INSIDE mpisppy_tpu/testing may
+# import each other freely — the contract binds the clean path only.
+from mpisppy_tpu.testing import faults
+
+
+def harness():
+    return faults

@@ -1,0 +1,15 @@
+# OBS001 clean negatives: catalogued names through every static
+# spelling the extractor understands (literal, f-string prefix,
+# concat prefix, .format prefix), plus a dynamic variable name the
+# rule deliberately skips.
+from mpisppy_tpu import obs
+
+
+def emit(i, reason, metric_name):
+    obs.counter_add("app.requests")
+    obs.histogram_observe("app.latency_seconds", 0.25)
+    obs.gauge_set(f"hub.flow.{i}", 3.0)
+    obs.counter_add("hub.flow." + reason)
+    obs.histogram_observe("hub.flow.{}".format(i), 1.0)
+    obs.event("app.event.started", {})
+    obs.counter_add(metric_name)     # unresolvable: skipped, not flagged

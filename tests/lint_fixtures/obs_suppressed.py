@@ -1,0 +1,6 @@
+# OBS001 suppressed: an uncatalogued name carrying a reason.
+from mpisppy_tpu import obs
+
+
+def emit():
+    obs.counter_add("scratch.debug_probe")   # lint: ok[OBS001] fixture: temporary local probe, never ships

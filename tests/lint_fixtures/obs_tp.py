@@ -1,0 +1,12 @@
+# OBS001 true positives: names/prefixes missing from the fixture
+# catalog, plus a fully dynamic name with no static prefix.
+from mpisppy_tpu import obs
+
+
+def emit(i, reason, name):
+    obs.counter_add("app.unknown_metric")              # not catalogued
+    obs.gauge_set(f"rogue.family.{i}", 1.0)            # prefix missing
+    obs.histogram_observe("rogue.{}".format(reason), 2.0)   # .format miss
+    obs.counter_add("rogue." + reason)                 # concat miss
+    obs.event("rogue.event", {})                       # event miss
+    obs.counter_add(f"{name}.total")                   # no static prefix

@@ -1,0 +1,7 @@
+# PURE001 suppressed: a declared-jax-free module with a reasoned,
+# explicitly gated jax import.
+
+
+def probe_backend():
+    import jax   # lint: ok[PURE001] fixture: optional probe behind a feature gate, never on the jax-free path
+    return jax.default_backend()

@@ -1,0 +1,10 @@
+# PURE001 true positive (jax-free half): this file is declared
+# jax-free in the fixture config, so any jax import — top-level or
+# function-local — is a finding.
+import jax
+import numpy as np
+
+
+def lazy_too():
+    from jax import numpy as jnp
+    return jnp, np, jax

@@ -1,0 +1,22 @@
+# SYNC001 clean negatives: host-shaped readbacks the heuristics must
+# NOT flag even in a hot-loop module — ctor config parsing, options
+# access, static-flag coercion of enclosing-function parameters.
+import numpy as np
+
+
+class Engine:
+    def __init__(self, opts):
+        self.eps = float(opts.get("subproblem_eps", 1e-8))
+        self.deadline = float(opts["wheel_deadline"])
+        self.rows = np.asarray([1, 2, 3])
+
+    def solve(self, w_on, prox_on, chunk=0):
+        key = ("fixed", bool(prox_on)) if w_on else bool(prox_on)
+        eps = float(self.options.get("eps", 0.0))
+        chunked = chunk > 0 and chunk < 16
+        return key, eps, chunked
+
+    def nested(self, w_on):
+        def _assemble(ci):
+            return dict(w_on=bool(w_on), ci=ci)
+        return _assemble(0)

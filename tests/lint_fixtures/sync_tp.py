@@ -1,0 +1,15 @@
+# SYNC001 true positives: every readback shape the rule must catch
+# when this file is classified hot-loop (tests/test_lint.py's fixture
+# config lists it in ``hot_loop``). Never executed — parsed only.
+import jax
+import numpy as np
+
+
+def hot_loop_step(state):
+    conv = float(state.conv_dev)             # float() of a device value
+    it = state.iters.item()                  # .item()
+    jax.block_until_ready(state.x)           # explicit blocking wait
+    host = np.asarray(state.pri_rel)         # np.asarray D2H
+    mat = np.array(state.residual_stack)     # np.array D2H
+    done = bool(state.mask_any)              # array bool()
+    return conv, it, host, mat, done

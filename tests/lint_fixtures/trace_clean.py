@@ -1,0 +1,23 @@
+# TRACE001 clean negatives: hashable statics (tuples), immutable
+# globals, and mutable globals read only by UNJITTED code.
+import jax
+
+_STATICS = ("bounds",)                  # tuple: immutable, fine
+_HOST_CACHE = {}                        # mutable, but no jit reads it
+
+
+def _impl(x, bounds):
+    return x
+
+
+solve = jax.jit(_impl, static_argnames=_STATICS)
+
+
+@jax.jit
+def reads_tuple(x):
+    return x if _STATICS else -x
+
+
+def host_side(x):
+    _HOST_CACHE["x"] = x                # host code may use it freely
+    return solve(x, bounds=(0, 4))      # tuple static: hashable

@@ -1,0 +1,25 @@
+# TRACE001 true positives: a jitted body closing over a mutable
+# module global, and unhashable static args at jit call sites.
+import jax
+
+_WARM_CACHE = {}
+_HISTORY = []
+
+
+@jax.jit
+def closes_over_dict(x):
+    return x * _WARM_CACHE["scale"]     # baked at trace time
+
+
+def _impl(x, sl):
+    return x
+
+
+solve_num = jax.jit(_impl, static_argnums=(1,))
+solve_named = jax.jit(_impl, static_argnames=("sl",))
+
+
+def call_sites(x):
+    a = solve_num(x, slice(0, 4))       # unhashable positional static
+    b = solve_named(x, sl=[1, 2, 3])    # unhashable keyword static
+    return a, b

@@ -373,18 +373,19 @@ def test_multiproc_telemetry_dir_resolution(tmp_path, monkeypatch):
 
 
 # ---------------- counter-catalog drift guard (CI satellite) ---------
+# One source of truth with the linter (ISSUE 12): the extractor IS
+# graft-lint's OBS001 rule, so this guard, ``python -m tools.lint``
+# and the regression gate can never disagree about what counts as an
+# emitted name.
 
-# any facade or registry call with a literal (or f-string) name:
-# obs.counter_add("..."), r.metrics.histogram_observe(f"..."), ...
-_METRIC_CALL = re.compile(
-    r"\b(?:counter_add|gauge_set|histogram_observe)\(\s*"
-    r"(f?)\"([^\"]+)\"")
+from tools.lint.rules.obscat import extract_names  # noqa: E402
 
 
-def _emitted_metric_names():
-    """Every literal metric name passed to the obs facade across the
-    source tree. f-string names contribute their static prefix (the
-    catalog documents those as ``prefix<...>`` families)."""
+def _emitted_names(kinds=("metric", "event")):
+    """Every statically resolvable metric/event name (or family
+    prefix) emitted across the source tree, via the OBS001 AST
+    extractor — literal, f-string, ``"x" + var`` and ``.format``
+    spellings all resolve to the catalogued prefix."""
     names = set()
     pkg = os.path.join(REPO, "mpisppy_tpu")
     for dirpath, _, files in os.walk(pkg):
@@ -393,26 +394,102 @@ def _emitted_metric_names():
                 continue
             src = open(os.path.join(dirpath, fn),
                        encoding="utf-8").read()
-            for m in _METRIC_CALL.finditer(src):
-                is_f, name = m.group(1), m.group(2)
-                if is_f:
-                    name = name.split("{", 1)[0]
-                names.add(name)
+            names |= extract_names(src, kinds=kinds)
     return names
 
 
 def test_counter_catalog_documents_every_metric():
-    """CI drift guard: a metric emitted anywhere in the source tree
-    must appear in the doc/observability.md catalog — otherwise the
-    catalog silently rots and analyze users chase undocumented
-    names."""
+    """CI drift guard: a metric or event name emitted anywhere in the
+    source tree must appear in the doc/observability.md catalog —
+    otherwise the catalog silently rots and analyze users chase
+    undocumented names. (The same check runs as lint rule OBS001 per
+    call site; this is the doc-side aggregate.)"""
     doc = open(os.path.join(REPO, "doc", "observability.md"),
                encoding="utf-8").read()
-    names = _emitted_metric_names()
-    assert len(names) >= 15, f"grep broke? only found {sorted(names)}"
+    names = _emitted_names()
+    assert len(names) >= 15, f"extractor broke? found {sorted(names)}"
     missing = sorted(n for n in names if n not in doc)
     assert not missing, \
-        f"metrics emitted but not in doc/observability.md: {missing}"
+        f"names emitted but not in doc/observability.md: {missing}"
+
+
+def test_obs001_extractor_agrees_with_legacy_grep():
+    """The ISSUE 12 swap contract: before replacing the historical
+    regex guard, the old grep and the new AST extractor must agree on
+    the current tree (counter/gauge/histogram subset — events are the
+    extractor's extension). One sanctioned difference: the extractor
+    sees BOTH arms of a conditional-name emission, the regex only the
+    first."""
+    legacy_re = re.compile(
+        r"\b(?:counter_add|gauge_set|histogram_observe)\(\s*"
+        r"(f?)\"([^\"]+)\"")
+    legacy = set()
+    pkg = os.path.join(REPO, "mpisppy_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn),
+                       encoding="utf-8").read()
+            for m in legacy_re.finditer(src):
+                name = m.group(2)
+                if m.group(1):
+                    name = name.split("{", 1)[0]
+                legacy.add(name)
+    new = _emitted_names(kinds=("metric",))
+    assert legacy - new == set(), \
+        f"legacy grep found names the extractor missed: {legacy - new}"
+    extras = new - legacy
+    assert all("accepted" in n or "rejected" in n for n in extras), \
+        f"unexplained extractor-only names: {extras}"
+
+
+# ---------------- lint stamp (ISSUE 12 satellite) ----------------
+
+def _mini_run_dir(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "events.jsonl").write_text(json.dumps(
+        {"type": "run_header", "t": 0.0, "schema": 2,
+         "run_id": "lintstamp"}) + "\n")
+    return d
+
+
+def test_analyze_lint_stamp(tmp_path):
+    """A ``lint.json`` report in the telemetry dir (written by
+    ``python -m tools.lint --out`` / the regression gate) adds a
+    one-line lint-status stamp to the report and a ``lint`` block to
+    ``--json``; absent file, no stamp."""
+    d = _mini_run_dir(tmp_path)
+    r = analyze.load_run(str(d))
+    assert analyze.lint_summary(r) is None
+    assert "lint:" not in analyze.render_report(r)
+
+    (d / "lint.json").write_text(json.dumps(
+        {"schema_version": 1, "files_checked": 102, "findings": [],
+         "suppressed": [{"rule": "SYNC001"}] * 17}))
+    r = analyze.load_run(str(d))
+    ls = analyze.lint_summary(r)
+    assert ls == {"status": "clean", "findings": 0, "suppressed": 17,
+                  "files_checked": 102}
+    rep = analyze.render_report(r)
+    assert "lint: clean" in rep and "17 suppressed" in rep
+
+    (d / "lint.json").write_text(json.dumps(
+        {"schema_version": 1, "files_checked": 102,
+         "findings": [{"rule": "OBS001", "path": "x.py", "line": 1,
+                       "col": 0, "message": "m"}],
+         "suppressed": []}))
+    rep = analyze.render_report(analyze.load_run(str(d)))
+    assert "1 FINDING(S)" in rep
+
+    # torn/odd payloads must stamp "unreadable", never crash the
+    # whole run report
+    for payload in ("{truncated", "null", "[]"):
+        (d / "lint.json").write_text(payload)
+        r = analyze.load_run(str(d))
+        assert analyze.lint_summary(r)["status"] == "unreadable"
+        assert "unreadable" in analyze.render_report(r)
 
 
 # ---------------- sharding section (ISSUE 6) ----------------

@@ -180,7 +180,9 @@ def test_clean_path_never_imports_testing(tmp_path):
     """THE zero-overhead contract: importing (and wiring) the whole
     multi-process wheel machinery must not import mpisppy_tpu.testing
     — the fault harness exists only in children given an explicit
-    plan."""
+    plan. This is the ONE runtime backstop for the contract; the
+    static side (every import site on every path) is graft-lint
+    PURE001 (tests/test_lint.py::test_pure001_static_over_real_tree)."""
     code = (
         "import sys\n"
         "import mpisppy_tpu.utils.multiproc\n"

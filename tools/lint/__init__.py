@@ -1,0 +1,31 @@
+"""graft-lint: a jax-free, stdlib-ast static analysis suite enforcing
+the engine's hottest invariants (doc/lint.md):
+
+    SYNC001   blocking device readback on the hot loop
+    DONATE001 read-after-donate through the donated-jit entry points
+    TRACE001  retrace hazards (mutable-global closures, unhashable
+              static args)
+    LOCK001   hub HTTP-shared state mutated outside its lock
+    PURE001   jax imports in jax-free modules / clean-path
+              mpisppy_tpu.testing imports
+    OBS001    metric/event names resolve against the observability
+              catalog
+
+Run: ``python -m tools.lint [--json] [paths]`` (default paths:
+``mpisppy_tpu tools``). Exit codes: 0 clean, 3 findings, 2 usage.
+"""
+
+from .engine import (  # noqa: F401
+    LINT_SCHEMA_VERSION,
+    DONATING_DEFAULT,
+    HOT_LOOP_DEFAULT,
+    JAX_FREE_DEFAULT,
+    LOCK_GUARDS_DEFAULT,
+    Finding,
+    LintConfig,
+    Module,
+    Rule,
+    lint_paths,
+    parse_suppressions,
+    registry,
+)

@@ -1,0 +1,74 @@
+"""CLI for graft-lint: ``python -m tools.lint [--json] [paths]``.
+
+Exit codes (tools/regression_gate.py and CI consume these):
+    0  clean (no unsuppressed findings)
+    3  findings
+    2  usage error (bad path, unknown rule)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import LintConfig, lint_paths, registry
+
+DEFAULT_PATHS = ("mpisppy_tpu", "tools")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graft-lint: static analysis for the engine's "
+                    "sync/donation/lock/purity/catalog contracts "
+                    "(doc/lint.md)")
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                   help="files or directories to lint (default: "
+                        "mpisppy_tpu tools)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report on stdout")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this file "
+                        "(e.g. a telemetry dir's lint.json — analyze "
+                        "stamps the report with it)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="RULE",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(registry().items()):
+            print(f"{name}  {rule.summary}")
+        return 0
+
+    cfg = LintConfig()
+    try:
+        report = lint_paths(args.paths, cfg, rules=args.rule)
+    except FileNotFoundError as e:
+        print(f"lint: no such path: {e}", file=sys.stderr)
+        return 2
+    except KeyError as e:
+        print(f"lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        for f in report["findings"]:
+            print(f"{f['path']}:{f['line']}:{f['col']}: "
+                  f"{f['rule']} {f['message']}")
+        print(f"lint: {len(report['findings'])} finding(s), "
+              f"{len(report['suppressed'])} suppressed, "
+              f"{report['files_checked']} files")
+    return 3 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""graft-lint rule registry: importing this package registers every
+rule with the engine (tools.lint.engine.register)."""
+
+from . import donate    # noqa: F401
+from . import lock      # noqa: F401
+from . import obscat    # noqa: F401
+from . import pure      # noqa: F401
+from . import sync      # noqa: F401
+from . import trace     # noqa: F401

@@ -1,0 +1,180 @@
+"""DONATE001 — read of a variable after a donated-jit call consumed it.
+
+PR 2's donation chain (doc/pipelining.md): ``donate_argnames`` deletes
+the input buffers — a later read raises
+``RuntimeError: Array has been deleted`` on device but often *works*
+on CPU tier-1 (the deleted check is backend-dependent in places), so
+the bug ships. The engine's donating entry points are configured in
+``engine.DONATING_DEFAULT``: the raw donated twins always donate, the
+driver wrappers (qp_solve, kernel_solve, ...) donate their ``state``
+only when called with ``donate=<not literally False>``.
+
+Analysis is linear per function scope (no CFG): a donation of name
+``x`` at line L flags any load of ``x`` after L unless some statement
+in between (including the donating statement's own assignment targets
+— ``state, *_ = qp_solve(..., state, donate=True)`` is the idiomatic
+healed form) rebinds ``x``. The conditional-twin alias pattern
+(``fn = _x_donated if donate else _x; fn(...)``) resolves through the
+alias conservatively.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, call_name, register
+
+
+def _donates(call: ast.Call, entry) -> bool:
+    """Does this call actually donate? Unconditional twins always do;
+    wrappers need a ``donate`` kwarg that is not literally False."""
+    _, _, needs_kwarg = entry
+    if not needs_kwarg:
+        return True
+    for kw in call.keywords:
+        if kw.arg == "donate":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return False
+
+
+def _same_flow(p1, p2) -> bool:
+    """Two branch paths can lie on one execution path iff they agree
+    on the arm of every branch node they share."""
+    d1 = dict(p1)
+    return all(d1.get(nid, arm) == arm for nid, arm in p2)
+
+
+def _donated_arg(call: ast.Call, entry):
+    """The AST node passed in the donated slot, or None."""
+    kwarg, pos, _ = entry
+    if kwarg:
+        for kw in call.keywords:
+            if kw.arg == kwarg:
+                return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+class _ScopeScan(ast.NodeVisitor):
+    """One function scope: collect donations, stores and loads in
+    source order (by line), resolving donated-twin aliases. Flow
+    awareness is deliberately shallow: events carry their branch path
+    (which arm of which if/try they sit in) so a donation in one arm
+    never flags a load in a sibling arm, and a donation inside a
+    ``return`` statement is not recorded at all (flow leaves the
+    scope with the call). Loops are scanned linearly — the repo idiom
+    rebinds on the donating line, so iteration-order aliasing is out
+    of scope."""
+
+    def __init__(self, donating):
+        self.donating = dict(donating)   # name -> entry (incl. aliases)
+        self.donations = []              # (var, line, end, callee, path)
+        self.stores = []                 # (var, line, path)
+        self.loads = []                  # (var, line, col, path)
+        self.path = ()                   # ((branch node id, arm), ...)
+        self.in_return = 0
+
+    def visit_FunctionDef(self, node):   # do not descend: outer scope only
+        for d in node.decorator_list:
+            self.visit(d)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass                             # inner scope, own bindings
+
+    def visit_Assign(self, node):
+        # alias: fn = _donated_twin  /  fn = _x_donated if c else _x
+        v = node.value
+        cands = []
+        if isinstance(v, ast.Name):
+            cands = [v.id]
+        elif isinstance(v, ast.IfExp):
+            cands = [n.id for n in (v.body, v.orelse)
+                     if isinstance(n, ast.Name)]
+        hit = next((c for c in cands if c in self.donating), None)
+        if hit is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.donating[t.id] = self.donating[hit]
+        self.generic_visit(node)
+
+    def _arms(self, node, arms):
+        nid = id(node)
+        for i, arm in enumerate(arms):
+            self.path += ((nid, i),)
+            for stmt in arm:
+                self.visit(stmt)
+            self.path = self.path[:-1]
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        self._arms(node, [node.body, node.orelse])
+
+    def visit_Try(self, node):
+        self._arms(node, [node.body]
+                   + [h.body for h in node.handlers]
+                   + [node.orelse, node.finalbody])
+
+    def visit_Return(self, node):
+        self.in_return += 1
+        self.generic_visit(node)
+        self.in_return -= 1
+
+    def visit_Call(self, node):
+        name = call_name(node)
+        entry = self.donating.get(name) if name else None
+        if entry and _donates(node, entry) and not self.in_return:
+            arg = _donated_arg(node, entry)
+            if isinstance(arg, ast.Name):
+                # the donation takes effect at the call's LAST line:
+                # args of a multi-line call are reads that feed the
+                # call itself, not reads of deleted buffers
+                end = getattr(node, "end_lineno", node.lineno)
+                self.donations.append(
+                    (arg.id, node.lineno, end, name, self.path))
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.stores.append((node.id, node.lineno, self.path))
+        elif isinstance(node.ctx, ast.Load):
+            self.loads.append(
+                (node.id, node.lineno, node.col_offset, self.path))
+
+
+@register
+class Donate001(Rule):
+    name = "DONATE001"
+    summary = ("variable read after being passed through a donated-jit "
+               "call in the same scope (buffers deleted on device)")
+
+    def check(self, mod, cfg):
+        out = []
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        for fn in funcs:
+            scan = _ScopeScan(cfg.donating)
+            for stmt in fn.body:
+                scan.visit(stmt)
+            for var, dline, dend, callee, dpath in scan.donations:
+                for lvar, lline, lcol, lpath in scan.loads:
+                    if lvar != var or lline <= dend \
+                            or not _same_flow(dpath, lpath):
+                        continue
+                    rebound = any(s == var and dline <= sl <= lline
+                                  and _same_flow(spath, lpath)
+                                  for s, sl, spath in scan.stores)
+                    if rebound:
+                        continue
+                    out.append(Finding(
+                        self.name, mod.relpath, lline, lcol,
+                        f"`{var}` read after `{callee}(...)` donated "
+                        f"its buffers at line {dline} — donated arrays "
+                        "are deleted on device "
+                        "(doc/pipelining.md donation contract)"))
+                    break   # one finding per donation is enough
+        return out

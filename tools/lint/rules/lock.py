@@ -1,0 +1,153 @@
+"""LOCK001 — mutation of HTTP-thread-shared hub state outside its lock.
+
+PR 8's live status server serves ``/status`` + ``/metrics`` from
+daemon HTTP threads inside the hub process; the hub thread mutates the
+bound-flow ledger (``_spoke_flow``) and the once-guards
+(``_watchdog_fired``, ``_preempted``) on every termination check. The
+lock map (``engine.LOCK_GUARDS_DEFAULT``: attribute -> lock attribute)
+says which lock must be lexically held (a ``with self.<lock>:`` block)
+to MUTATE each attribute. ``__init__`` is exempt — no other thread
+exists before construction returns.
+
+Mutation means: assignment / augassign to ``self.<attr>`` or a
+subscript of it, a mutating method call (``append``/``update``/
+``pop``/...), and the same through a local alias bound from
+``self.<attr>`` or ``self.<attr>[...]`` (the ledger idiom
+``flow = self._spoke_flow[i]; flow["produced"] += 1``). Reads are out
+of scope: the guarded structures are swapped whole under the lock, and
+flagging every read would bury the writes the rule exists to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, register
+
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "update", "setdefault", "popitem", "add", "discard"}
+
+
+def _self_attr(node, selfname):
+    """``self.<attr>`` -> attr name, through any subscript chain
+    (``self.<attr>[i]["k"]`` -> attr)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == selfname:
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    def __init__(self, rule, mod, cfg, selfname):
+        self.rule, self.mod, self.cfg = rule, mod, cfg
+        self.selfname = selfname
+        self.guards = cfg.lock_guards
+        self.held = []          # stack of lock attr names held
+        self.aliases = {}       # local name -> guarded attr
+        self.out = []
+
+    # ---- lock tracking
+    def visit_With(self, node):
+        entered = []
+        for item in node.items:
+            ctx = item.context_expr
+            attr = _self_attr(ctx, self.selfname)
+            if attr and attr.endswith("_lock"):
+                entered.append(attr)
+        self.held.extend(entered)
+        for item in node.items:
+            self.visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in entered:
+            self.held.pop()
+
+    def _flag(self, node, attr, how):
+        lock = self.guards[attr]
+        self.out.append(Finding(
+            self.rule.name, self.mod.relpath, node.lineno,
+            node.col_offset,
+            f"{how} of `self.{attr}` outside `with self.{lock}:` — "
+            "shared with the status-server HTTP threads "
+            "(doc/observability.md live plane)"))
+
+    def _target_guarded(self, target):
+        """Guarded attr mutated by storing to ``target``, or None."""
+        attr = _self_attr(target, self.selfname)
+        if attr in self.guards:
+            return attr
+        # alias subscript store: flow["produced"] = ...
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Name):
+            return self.aliases.get(target.value.id)
+        return None
+
+    def _check_store(self, target, node):
+        attr = self._target_guarded(target)
+        if attr and self.guards[attr] not in self.held:
+            self._flag(node, attr, "write")
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check_store(t, node)
+        # alias binding: flow = self._spoke_flow[i]; a rebind to
+        # anything else KILLS the alias — the local now names an
+        # unguarded value
+        v = node.value
+        vattr = _self_attr(v, self.selfname)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if vattr in self.guards:
+                    self.aliases[t.id] = vattr
+                else:
+                    self.aliases.pop(t.id, None)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            base = fn.value
+            attr = _self_attr(base, self.selfname)
+            if attr is None and isinstance(base, ast.Subscript) \
+                    and isinstance(base.value, ast.Name):
+                attr = self.aliases.get(base.value.id)
+            if attr is None and isinstance(base, ast.Name):
+                attr = self.aliases.get(base.id)
+            if attr in self.guards \
+                    and self.guards[attr] not in self.held:
+                self._flag(node, attr, f"`.{fn.attr}()`")
+        self.generic_visit(node)
+
+
+@register
+class Lock001(Rule):
+    name = "LOCK001"
+    summary = ("hub flow-ledger / once-guard state mutated outside its "
+               "lock in code the status-server threads race")
+
+    def check(self, mod, cfg):
+        out = []
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name == "__init__":
+                    continue     # single-threaded until ctor returns
+                args = meth.args.posonlyargs + meth.args.args
+                if not args:
+                    continue
+                scan = _MethodScan(self, mod, cfg, args[0].arg)
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                out.extend(scan.out)
+        return out

@@ -1,0 +1,162 @@
+"""OBS001 — every metric/event name in source resolves against the
+doc/observability.md catalog.
+
+Subsumes the historical grep-based drift guard (tests/test_analyze.py)
+as a real extractor: any ``counter_add`` / ``gauge_set`` /
+``histogram_observe`` call (facade or registry method) plus
+``obs.event`` with a resolvable name must appear in the catalog —
+names the docs don't carry rot analyze's report and the Prometheus
+surface silently.
+
+Name resolution (static prefixes, matching the old guard's substring
+semantics so the two agree on the same tree):
+
+* string literal -> the full name;
+* f-string -> the leading literal prefix (the catalog documents these
+  as ``prefix<...>`` families, e.g. ``hub.bound_rejected.<reason>``);
+* ``"prefix" + var`` / ``"prefix{}".format(var)`` -> the same prefix;
+* a bare variable -> skipped (nothing checkable statically; the
+  runtime drift guard's successor, analyze's catalog section, still
+  sees it).
+
+An empty static prefix (f-string starting with a placeholder) is its
+own finding: a fully dynamic name can never be catalogued.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, register
+
+_EMITTERS = {"counter_add", "gauge_set", "histogram_observe"}
+
+_SKIP = object()      # un-checkable (dynamic name in a variable)
+
+
+def _static_name(node):
+    """(name_or_prefix, is_prefix) for a metric-name argument, or
+    ``_SKIP``, or None for an empty (uncatalogable) prefix."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):
+        prefix = ""
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                prefix += str(part.value)
+            else:
+                break
+        return (prefix, True) if prefix else None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _static_name(node.left)
+        if left not in (None, _SKIP):
+            name, _ = left
+            return (name, True) if name else None
+        return None
+    if isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "format":
+        base = _static_name(node.func.value)
+        if base not in (None, _SKIP):
+            name, _ = base
+            prefix = name.split("{", 1)[0]
+            return (prefix, True) if prefix else None
+        return None
+    return _SKIP
+
+
+def iter_emissions(tree):
+    """Yield (call_node, kind, name, is_prefix, bad) for every
+    metric/event emission with a statically analyzable name; ``kind``
+    is "metric" or "event", ``bad`` is True when the name is fully
+    dynamic (no static prefix at all)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        is_metric = (isinstance(fn, ast.Name) and fn.id in _EMITTERS) \
+            or (isinstance(fn, ast.Attribute) and fn.attr in _EMITTERS)
+        # event emissions: the obs facade, or any receiver (`r.event`,
+        # the Recorder spelling in obs/resource.py) when the name is a
+        # dotted metric-style literal — the dot requirement keeps
+        # unrelated `.event("x")` APIs out of scope
+        is_event = False
+        if isinstance(fn, ast.Attribute) and fn.attr == "event":
+            if isinstance(fn.value, ast.Name) and fn.value.id == "obs":
+                is_event = True
+            else:
+                a = node.args[0]
+                is_event = isinstance(a, ast.Constant) \
+                    and isinstance(a.value, str) and "." in a.value
+        if not (is_metric or is_event):
+            continue
+        kind = "metric" if is_metric else "event"
+        arg = node.args[0]
+        # a conditional name (f"...accepted..." if ok else
+        # f"...rejected...") emits under BOTH arms — check each
+        arms = [arg.body, arg.orelse] if isinstance(arg, ast.IfExp) \
+            else [arg]
+        for a in arms:
+            res = _static_name(a)
+            if res is _SKIP:
+                continue
+            if res is None:
+                yield node, kind, "", True, True
+            else:
+                name, is_prefix = res
+                yield node, kind, name, is_prefix, False
+
+
+def extract_names(source: str, kinds=("metric", "event")) -> set:
+    """Every statically resolvable metric/event name (or f-string /
+    concat / .format prefix) emitted by ``source`` — the drift guard's
+    extractor (tests/test_analyze.py builds the repo-wide set from
+    this; one source of truth with the OBS001 rule)."""
+    return {name for _, kind, name, _, bad
+            in iter_emissions(ast.parse(source))
+            if not bad and kind in kinds}
+
+
+@register
+class Obs001(Rule):
+    name = "OBS001"
+    summary = ("metric/event name not in the doc/observability.md "
+               "catalog (or fully dynamic, so it can never be)")
+
+    def check(self, mod, cfg):
+        catalog = cfg.catalog_text()
+        out = []
+        if not catalog:
+            # a missing/empty catalog must not silently disable the
+            # rule (the tree would read clean with zero enforcement) —
+            # any module that emits names gets ONE finding naming the
+            # configuration problem
+            first = next(iter(iter_emissions(mod.tree)), None)
+            if first is not None:
+                node = first[0]
+                out.append(Finding(
+                    self.name, mod.relpath, node.lineno,
+                    node.col_offset,
+                    "metric/event emissions present but no catalog "
+                    f"text loaded from {cfg.catalog_paths!r} — OBS001 "
+                    "cannot verify names against a missing catalog"))
+            return out
+        for node, _kind, name, is_prefix, bad in iter_emissions(mod.tree):
+            if bad:
+                out.append(Finding(
+                    self.name, mod.relpath, node.lineno,
+                    node.col_offset,
+                    "metric/event name has no static prefix — a fully "
+                    "dynamic name can never resolve against the "
+                    "doc/observability.md catalog"))
+                continue
+            if name not in catalog:
+                kind = "prefix" if is_prefix else "name"
+                out.append(Finding(
+                    self.name, mod.relpath, node.lineno,
+                    node.col_offset,
+                    f"metric/event {kind} `{name}` is not in the "
+                    "doc/observability.md catalog — document it or "
+                    "fix the name (the analyze/Prometheus surface "
+                    "reads the catalog as truth)"))
+        return out

@@ -1,0 +1,69 @@
+"""PURE001 — import purity: jax-free modules and the clean-path
+``mpisppy_tpu.testing`` contract.
+
+Two halves, both enforced today only by fresh-interpreter runtime
+probes (one code path at a time):
+
+* declared jax-free modules (``engine.JAX_FREE_DEFAULT``: ckpt/,
+  obs/analyze, obs/merge, utils/config, testing/faults, tools/) must
+  never import jax — anywhere in the file, function-local included.
+  These modules are the checkpoint/analysis/CI surface that must load
+  on hosts with no accelerator stack;
+* nothing under ``mpisppy_tpu/`` outside ``mpisppy_tpu/testing/``
+  imports ``mpisppy_tpu.testing`` — the fault harness exists ONLY in
+  children given an explicit plan. The two env-gated injector sites in
+  utils/multiproc.py carry reasoned suppressions; anything else is a
+  clean-path contamination the tier-1 probe would catch only if its
+  exact path runs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, register
+
+
+def _imports(tree):
+    """Yield (node, module_name) for every import statement."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                yield node, a.name, 0
+        elif isinstance(node, ast.ImportFrom):
+            yield node, node.module or "", node.level
+
+
+@register
+class Pure001(Rule):
+    name = "PURE001"
+    summary = ("jax import in a declared jax-free module, or a "
+               "mpisppy_tpu.testing import on the clean path")
+
+    def check(self, mod, cfg):
+        out = []
+        jax_free = cfg.is_jax_free(mod.relpath)
+        in_pkg = mod.relpath.startswith("mpisppy_tpu/")
+        in_testing = mod.relpath.startswith(cfg.testing_package)
+        for node, name, level in _imports(mod.tree):
+            if jax_free and (name == "jax" or name.startswith("jax.")):
+                out.append(Finding(
+                    self.name, mod.relpath, node.lineno,
+                    node.col_offset,
+                    f"`{mod.relpath}` is declared jax-free but imports "
+                    f"`{name}` — ckpt/analyze/config/tools must load "
+                    "with no accelerator stack (doc/lint.md)"))
+            if in_pkg and not in_testing:
+                absolute = name == "mpisppy_tpu.testing" \
+                    or name.startswith("mpisppy_tpu.testing.")
+                relative = level > 0 and (
+                    name == "testing" or name.startswith("testing."))
+                if absolute or relative:
+                    out.append(Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset,
+                        "clean-path import of `mpisppy_tpu.testing` — "
+                        "the fault harness loads only in children with "
+                        "an explicit plan (suppress at env-gated "
+                        "sites with the gate as the reason)"))
+        return out

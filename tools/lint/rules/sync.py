@@ -1,0 +1,130 @@
+"""SYNC001 — blocking device readback on the hot loop.
+
+The O(1)-gate-syncs-per-iteration contract (doc/pipelining.md) means
+every ``float()``/``.item()``/``np.asarray``/``bool()`` of a device
+array and every ``block_until_ready`` inside the hot-loop modules
+(engine.HOT_LOOP_DEFAULT) is a host sync that serializes chunk k's
+solve with chunk k+1's dispatch — SURVEY's roofline mandate says each
+one is a perf bug unless it IS the designed gate. The runtime
+``ph.gate_syncs`` counter test catches a violation only on the code
+path it exercises; this rule catches all paths at once.
+
+What is deliberately NOT flagged (host-shaped heuristics): readbacks
+in ``__init__`` bodies (config parsing), ``float()`` of constants /
+``.get()`` results / anything mentioning options/config/env — those
+never touch device buffers. Every remaining site is either a bug or a
+designed gate carrying a reasoned ``# lint: ok[SYNC001]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Finding, Rule, dotted, register
+
+# expressions that are host data by construction: config dictionaries,
+# environment, shapes/sizes, wall clocks
+_HOST_HINT = re.compile(
+    r"\b(opts?|options|config|cfg|environ|getenv|kwargs|kw|"
+    r"shape|ndim|len|time|perf_counter|monotonic)\b")
+
+_HOST_CALLS = {"len", "int", "str", "repr", "getattr", "min", "max",
+               "abs", "round", "float", "bool"}
+
+
+def _host_shaped(node) -> bool:
+    """True when ``node`` can only be host data (never a device
+    array) — skip it instead of demanding a suppression."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _host_shaped(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _host_shaped(node.left) and _host_shaped(node.right)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "get":
+            return True          # opts.get(...) and friends
+        if isinstance(fn, ast.Name) and fn.id in _HOST_CALLS:
+            return all(_host_shaped(a) for a in node.args) \
+                or bool(_HOST_HINT.search(ast.unparse(node)))
+    return bool(_HOST_HINT.search(ast.unparse(node)))
+
+
+def _fn_params(fn_node) -> set:
+    a = fn_node.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)} \
+        | ({a.vararg.arg} if a.vararg else set()) \
+        | ({a.kwarg.arg} if a.kwarg else set())
+
+
+@register
+class Sync001(Rule):
+    name = "SYNC001"
+    summary = ("blocking device readback (float/.item/np.asarray/bool/"
+               "block_until_ready) in a hot-loop module outside an "
+               "allowlisted gate site")
+
+    def check(self, mod, cfg):
+        if not cfg.is_hot(mod.relpath):
+            return []
+        allow = cfg.sync_allow.get(mod.relpath, {})
+        out = []
+
+        def allowed(qualname: str) -> bool:
+            return any(qualname == q or qualname.startswith(q + ".")
+                       for q in allow)
+
+        def flag(node, what):
+            out.append(Finding(
+                self.name, mod.relpath, node.lineno, node.col_offset,
+                f"{what} is a blocking D2H sync on the hot loop — fuse "
+                "it into the stacked gate, allowlist the function as a "
+                "gate site, or suppress with the reason it IS the gate "
+                "(doc/pipelining.md)"))
+
+        def visit(node, qual, fn_stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    visit(child, q, fn_stack + [child])
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    visit(child, q, fn_stack)
+                    continue
+                if isinstance(child, ast.Call) and fn_stack \
+                        and fn_stack[-1].name != "__init__" \
+                        and not allowed(qual):
+                    self._check_call(child, fn_stack, flag)
+                visit(child, qual, fn_stack)
+
+        visit(mod.tree, "", [])
+        return out
+
+    def _check_call(self, node, fn_stack, flag):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not node.args:
+                flag(node, "`.item()`")
+            elif fn.attr == "block_until_ready":
+                flag(node, "`block_until_ready`")
+            elif fn.attr in ("asarray", "array") and \
+                    dotted(fn.value) in ("np", "numpy", "onp"):
+                if node.args and not _host_shaped(node.args[0]):
+                    flag(node, f"`np.{fn.attr}` of a device value")
+        elif isinstance(fn, ast.Name):
+            if fn.id in ("float", "bool") and len(node.args) == 1 \
+                    and not node.keywords:
+                arg = node.args[0]
+                # static-flag coercion idiom: bool(w_on)/float(eps)
+                # of an enclosing function's own parameter is host
+                # scalar plumbing (jit static args, dict keys), not
+                # a device readback
+                if isinstance(arg, ast.Name) and any(
+                        arg.id in _fn_params(f) for f in fn_stack):
+                    return
+                if not _host_shaped(arg):
+                    flag(node, f"`{fn.id}()` of a device value")

@@ -1,0 +1,212 @@
+"""TRACE001 — retrace hazards: jitted functions closing over mutable
+module globals, and known-unhashable static args at jit call sites.
+
+Two concrete failure shapes from this repo's history:
+
+* the PR 1 seed bug: a ``slice`` passed as a static jit arg raises
+  ``ValueError: unhashable static arguments`` at call time (fixed by
+  ``SPBase.slot_bounds`` — tuples are hashable, slices are not);
+* a jitted body reading a module-level ``list``/``dict``/``set``: the
+  value is baked at trace time, so later mutation either silently uses
+  stale data or forces a retrace per new identity — the
+  ``no_late_retraces`` analyze invariant sees the symptom at runtime,
+  this rule sees the cause statically.
+
+In-module analysis only: jit wrappers are recognized as ``jax.jit`` /
+``jit`` / ``partial(jax.jit, ...)`` decorators or ``g = jax.jit(f,
+static_argnums=... / static_argnames=...)`` assignments (static specs
+resolve through module-level constants).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, dotted, register
+
+_MUTABLE_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                  "Counter"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _literal_names(node, consts):
+    """Resolve a static_argnames spec to a tuple of strings (through
+    one level of module constants); None when unresolvable."""
+    if isinstance(node, ast.Name):
+        node = consts.get(node.id)
+        if node is None:
+            return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _literal_nums(node, consts):
+    if isinstance(node, ast.Name):
+        node = consts.get(node.id)
+        if node is None:
+            return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _is_jit_call(node):
+    """``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted(node.func)
+    if d in ("jax.jit", "jit"):
+        return node
+    if d in ("partial", "functools.partial") and node.args:
+        inner = dotted(node.args[0])
+        if inner in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+@register
+class Trace001(Rule):
+    name = "TRACE001"
+    summary = ("jitted function closes over a mutable module global, "
+               "or a jit call site passes a known-unhashable static arg")
+
+    def check(self, mod, cfg):
+        out = []
+        consts = {}          # module-level Name -> value AST
+        mutable_globals = {}  # name -> lineno of the mutable binding
+        jitted_defs = {}     # function name -> FunctionDef (jit-wrapped)
+        statics = {}         # callable name -> (argnums, argnames, base fn)
+
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tname = stmt.targets[0].id
+                consts[tname] = stmt.value
+                v = stmt.value
+                is_mut = isinstance(v, _UNHASHABLE) or (
+                    isinstance(v, ast.Call)
+                    and dotted(v.func) in _MUTABLE_CALLS)
+                if is_mut and not tname.startswith("__"):
+                    mutable_globals[tname] = stmt.lineno
+                jc = _is_jit_call(v)
+                if jc is not None:
+                    base = None
+                    if jc.args and isinstance(jc.args[0], ast.Name):
+                        base = jc.args[0].id
+                    nums = names = None
+                    for kw in jc.keywords:
+                        if kw.arg == "static_argnums":
+                            nums = _literal_nums(kw.value, consts)
+                        elif kw.arg == "static_argnames":
+                            names = _literal_names(kw.value, consts)
+                    statics[tname] = (nums or (), names or (), base)
+                    if base is not None:
+                        jitted_defs[base] = None   # resolved below
+            elif isinstance(stmt, ast.FunctionDef):
+                for dec in stmt.decorator_list:
+                    if _is_jit_call(dec) is not None or \
+                            dotted(dec) in ("jax.jit", "jit"):
+                        jitted_defs[stmt.name] = stmt
+                        jc = _is_jit_call(dec)
+                        if jc is not None:
+                            nums = names = None
+                            for kw in jc.keywords:
+                                if kw.arg == "static_argnums":
+                                    nums = _literal_nums(kw.value, consts)
+                                elif kw.arg == "static_argnames":
+                                    names = _literal_names(kw.value,
+                                                           consts)
+                            statics[stmt.name] = (nums or (), names or (),
+                                                  stmt.name)
+                if stmt.name in jitted_defs and \
+                        jitted_defs[stmt.name] is None:
+                    pass
+                # record defs so `g = jax.jit(f)` can find f's body
+                consts.setdefault(stmt.name, None)
+
+        # resolve jit-wrapped base functions to their defs
+        defs = {n.name: n for n in mod.tree.body
+                if isinstance(n, ast.FunctionDef)}
+        for name in list(jitted_defs):
+            if jitted_defs[name] is None:
+                jitted_defs[name] = defs.get(name)
+
+        # check 1: jitted bodies reading mutable module globals
+        for fname, fdef in jitted_defs.items():
+            if fdef is None:
+                continue
+            # a name is only a CLOSURE read if nothing in the function
+            # binds it: parameters and any assignment make it local
+            # (Python scoping), unless an explicit `global` undoes that
+            params = {a.arg for a in (
+                fdef.args.posonlyargs + fdef.args.args
+                + fdef.args.kwonlyargs)}
+            stores = {n.id for n in ast.walk(fdef)
+                      if isinstance(n, ast.Name)
+                      and isinstance(n.ctx, ast.Store)}
+            globals_decl = {g for n in ast.walk(fdef)
+                            if isinstance(n, ast.Global)
+                            for g in n.names}
+            local_names = (params | stores) - globals_decl
+            for sub in ast.walk(fdef):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load) \
+                        and sub.id in mutable_globals \
+                        and sub.id not in local_names:
+                    out.append(Finding(
+                        self.name, mod.relpath, sub.lineno,
+                        sub.col_offset,
+                        f"jitted `{fname}` closes over mutable module "
+                        f"global `{sub.id}` (bound line "
+                        f"{mutable_globals[sub.id]}) — baked at trace "
+                        "time; mutation goes stale or retraces "
+                        "(analyze's no_late_retraces invariant)"))
+
+        # check 2: unhashable static args at call sites of jitted names
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = None
+            if isinstance(node.func, ast.Name):
+                cname = node.func.id
+            if cname not in statics:
+                continue
+            nums, names, _ = statics[cname]
+            bad = []
+            for i, a in enumerate(node.args):
+                if i in nums and isinstance(a, _UNHASHABLE + (ast.Call,)) \
+                        and (not isinstance(a, ast.Call)
+                             or dotted(a.func) == "slice"):
+                    bad.append((a, f"positional {i}"))
+            for kw in node.keywords:
+                if kw.arg in names:
+                    a = kw.value
+                    if isinstance(a, _UNHASHABLE) or (
+                            isinstance(a, ast.Call)
+                            and dotted(a.func) == "slice"):
+                        bad.append((a, f"`{kw.arg}`"))
+            for a, where in bad:
+                out.append(Finding(
+                    self.name, mod.relpath, a.lineno, a.col_offset,
+                    f"call to jitted `{cname}` passes an unhashable "
+                    f"value as static arg {where} — raises at call "
+                    "time (the PR 1 `slice` bug; use a tuple)"))
+        return out

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Tier-1 perf regression gate: farmer bench vs committed golden run.
+"""Tier-1 perf regression gate: graft-lint + farmer bench vs committed
+golden run.
 
 The ISSUE 8 CI satellite: perf regressions used to surface only on the
 driver (a BENCH re-run on real hardware, days later). This gate runs
@@ -7,6 +8,12 @@ the SMALL farmer bench wheel with telemetry on and diffs it against a
 COMMITTED golden telemetry directory with ``analyze --compare``, so a
 per-iteration time or counter regression (gate syncs per solve call,
 total compile count, phase s/call) fails in-repo, at tier-1 speed.
+
+Since ISSUE 12 the gate runs ``python -m tools.lint`` FIRST: a new
+blocking-sync / read-after-donate / unlocked-ledger / purity / catalog
+violation fails statically in seconds, before any bench cycles, and
+the JSON report lands in the fresh telemetry dir as ``lint.json`` so
+``analyze`` stamps the compared run with its lint status.
 
 Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
 3 REGRESSION.
@@ -48,6 +55,19 @@ BENCH_ARGS = ["farmer", "--num-scens", "3", "--max-iterations", "5",
               "--convthresh", "-1", "--subproblem-max-iter", "1500",
               "--with-lagrangian", "--with-xhatshuffle", "--with-dive",
               "--rel-gap", "1e-6"]
+
+
+def run_lint(out_path=None) -> int:
+    """The ISSUE 12 CI step: ``python -m tools.lint`` over the package
+    + tools BEFORE any bench cycles are spent — a new sync/donation/
+    lock/purity/catalog violation fails the gate statically, at parse
+    speed. ``out_path`` lands the JSON report in the fresh telemetry
+    dir so ``analyze`` stamps the run with its lint status."""
+    cmd = [sys.executable, "-m", "tools.lint", "mpisppy_tpu", "tools"]
+    if out_path:
+        cmd += ["--out", out_path]
+    r = subprocess.run(cmd, cwd=REPO, timeout=300)
+    return r.returncode
 
 
 def run_bench(out_dir: str, extra_args=()) -> int:
@@ -108,6 +128,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.update_golden:
+        rc = run_lint()
+        if rc != 0:
+            print("regression_gate: lint failed — fix or suppress "
+                  "(doc/lint.md) before re-baselining")
+            return rc
         os.makedirs(os.path.dirname(args.golden), exist_ok=True)
         shutil.rmtree(args.golden, ignore_errors=True)
         rc = run_bench(args.golden)
@@ -130,6 +155,16 @@ def main(argv=None) -> int:
 
     fresh = args.keep or tempfile.mkdtemp(prefix="regression_gate_")
     try:
+        # lint gate first (static, seconds): new contract violations
+        # fail before the bench spends minutes; the report rides the
+        # fresh telemetry dir so analyze stamps the compared run
+        os.makedirs(fresh, exist_ok=True)
+        rc = run_lint(out_path=os.path.join(fresh, "lint.json"))
+        if rc != 0:
+            print("regression_gate: LINT FAILURE — `python -m "
+                  "tools.lint` found unsuppressed findings (fix the "
+                  "violation or suppress with a reason, doc/lint.md)")
+            return rc
         # the fresh side runs WITH checkpoint capture armed (the
         # golden stays minimal): checkpoint writes ride the compared
         # run, so a capture-induced gate sync / device_put / phase
