@@ -17,6 +17,10 @@ The ``analyze`` subcommand consumes a run's ``--telemetry-dir``
 artifacts instead of launching one (obs/analyze.py; no jax needed):
   python -m mpisppy_tpu analyze runs/t1
   python -m mpisppy_tpu analyze --compare runs/base runs/candidate
+
+The ``serve`` subcommand starts the persistent serving layer
+(mpisppy_tpu/serve/, doc/serving.md) instead of a one-shot wheel:
+  python -m mpisppy_tpu serve --port 8765 --state-dir runs/serve
 """
 
 from __future__ import annotations
@@ -242,6 +246,12 @@ def main(argv=None):
         # touches jax or the device runtime
         from .obs.analyze import main as analyze_main
         return analyze_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # the persistent serving layer (mpisppy_tpu/serve): compile
+        # once, batch many instances, serve concurrent wheels — one
+        # long-lived process instead of one wheel per invocation
+        from .serve.manager import serve_main
+        return serve_main(argv[1:])
     args = make_parser().parse_args(argv)
     from .utils.runtime import setup_jax_runtime
 

@@ -79,6 +79,15 @@ def _atomic_write_bytes(path: str, data: bytes):
     os.replace(tmp, path)
 
 
+def atomic_write_json(path: str, obj, indent=1):
+    """One JSON document under the tmp+``os.replace`` contract — THE
+    durability pattern of this package, exported so its consumers
+    (serve request records, group files, the endpoint file) share one
+    implementation instead of hand-rolling the sequence."""
+    _atomic_write_bytes(path,
+                        (json.dumps(obj, indent=indent) + "\n").encode())
+
+
 def atomic_savez(path: str, **arrays):
     """``np.savez`` with the tmp+rename contract — and WITHOUT savez's
     implicit ``.npz`` suffix games (the file lands at exactly

@@ -44,8 +44,13 @@ class Hub(SPCommunicator):
         self.bound_events = []
         self.clock_anchor = {"wall_time_unix": time.time(),
                              "perf_counter": time.perf_counter()}
+        # service-plane tag (mpisppy_tpu/serve): the wheel manager
+        # stamps each hub with its request/group id so /status and the
+        # event stream can attribute concurrent wheels to tenants
+        self.request_tag = (options or {}).get("request_tag")
         sh = getattr(spbase_object, "_shard_ops", None)
         obs.event("hub.start", {"hub": type(self).__name__,
+                                "request_tag": self.request_tag,
                                 "spokes": len(self.spokes),
                                 # engine sharding anatomy (analyze's
                                 # sharding section reads this + the
@@ -516,6 +521,7 @@ class Hub(SPCommunicator):
         snap = {"type": "live", "schema": obs.SCHEMA_VERSION,
                 "run_id": rec.run_id if rec is not None else None,
                 "hub": type(self).__name__,
+                "request_tag": self.request_tag,
                 "wall_time_unix": time.time(),
                 "t": time.perf_counter(),
                 "elapsed_seconds": time.monotonic() - self._wheel_t0,

@@ -70,6 +70,35 @@ _DEFAULTS = {
 KNOWN_OPTIONS = (*_DEFAULTS, "crossed_bound_tol")
 
 
+class WheelDeadline:
+    """The watchdog timer half of the supervisor, standalone — for
+    wheels with no spoke processes to supervise (the serving layer's
+    in-process hub-only wheels, mpisppy_tpu/serve). Arms a daemon
+    timer that fires the hub's once-guarded :meth:`Hub.fire_watchdog`
+    if the wheel outlives its deadline, even when an iteration wedges
+    and the hub never reaches another termination check — exactly
+    ``WheelSupervisor.start_watchdog``'s contract, minus the process
+    management."""
+
+    def __init__(self, hub, deadline: float):
+        self.hub = hub
+        self._timer = threading.Timer(float(deadline), self._fire)
+        self._timer.daemon = True
+        self._cancelled = False
+
+    def start(self):
+        self._timer.start()
+        return self
+
+    def _fire(self):
+        if not self._cancelled and self.hub is not None:
+            self.hub.fire_watchdog("deadline_timer")
+
+    def cancel(self):
+        self._cancelled = True
+        self._timer.cancel()
+
+
 class _SpokeHealth:
     __slots__ = ("state", "crashes", "rejections", "next_respawn_at",
                  "last_wid", "last_progress", "gen")

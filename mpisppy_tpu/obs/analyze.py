@@ -391,6 +391,54 @@ def checkpoint_summary(run: Run) -> dict | None:
     }
 
 
+def serving_summary(run: Run) -> dict | None:
+    """Serving-layer activity (mpisppy_tpu/serve, doc/serving.md):
+    request admission/outcome totals, warm-cache hit ratio, the batch
+    occupancy histogram, and per-bucket compile counts. None when the
+    run never served — the section only renders for serve-process
+    telemetry dirs."""
+    tot = {}
+    for role in run.metrics:
+        for k, v in run.counters(role).items():
+            if k.startswith("serve."):
+                tot[k] = tot.get(k, 0) + v
+    if not tot and not run.of("serve.start"):
+        return None
+    hits = int(tot.get("serve.cache.hit", 0))
+    misses = int(tot.get("serve.cache.miss", 0))
+    per_bucket = {k[len("serve.bucket.compiles."):]: int(v)
+                  for k, v in tot.items()
+                  if k.startswith("serve.bucket.compiles.")}
+    occ = None
+    for role in run.metrics:
+        h = run.histograms(role).get("serve.batch.occupancy")
+        if h:
+            occ = h
+            break
+    return {
+        "admitted": int(tot.get("serve.requests.admitted", 0)),
+        "completed": int(tot.get("serve.requests.completed", 0)),
+        "failed": int(tot.get("serve.requests.failed", 0)),
+        "rejected": int(tot.get("serve.requests.rejected", 0)),
+        "deadline_missed": int(tot.get("serve.requests.deadline_missed",
+                                       0)),
+        "preempted_requests": int(tot.get("serve.requests.preempted",
+                                          0)),
+        "resumed": int(tot.get("serve.requests.resumed", 0)),
+        "wheels": int(tot.get("serve.wheels", 0)),
+        "stacked_wheels": int(tot.get("serve.batch.wheels", 0)),
+        "coalesced": int(tot.get("serve.batch.coalesced", 0)),
+        "chain_steps": int(tot.get("serve.chain.steps", 0)),
+        "cache_hits": hits, "cache_misses": misses,
+        "cache_evictions": int(tot.get("serve.cache.evict", 0)),
+        "cache_hit_ratio": (hits / (hits + misses))
+        if hits + misses else None,
+        "batch_occupancy": occ,
+        "per_bucket_compiles": per_bucket,
+        "service_preempted": bool(int(tot.get("serve.preempted", 0))),
+    }
+
+
 def bound_flow_summary(run: Run) -> dict | None:
     """Per-spoke bound-flow ledger + verdict — the live-plane answer to
     ROADMAP item 1's diagnostic question ("is the Lagrangian spoke
@@ -819,6 +867,40 @@ def render_report(run: Run) -> str:
                      "bundle captured before terminate")
         L.append("")
 
+    sv = serving_summary(run)
+    if sv is not None:
+        L.append("== serving ==")
+        L.append(f"requests: {sv['admitted']} admitted  "
+                 f"{sv['completed']} completed  {sv['failed']} failed  "
+                 f"{sv['deadline_missed']} deadline-missed  "
+                 f"{sv['rejected']} rejected  "
+                 f"{sv['preempted_requests']} preempted  "
+                 f"{sv['resumed']} resumed")
+        ratio = sv["cache_hit_ratio"]
+        L.append(f"warm cache: {sv['cache_hits']} hit(s) / "
+                 f"{sv['cache_misses']} miss(es)"
+                 + (f" (hit ratio {_fmt(ratio, 2)})"
+                    if ratio is not None else "")
+                 + f"  evictions {sv['cache_evictions']}")
+        L.append(f"wheels: {sv['wheels']} total  "
+                 f"{sv['stacked_wheels']} stacked "
+                 f"({sv['coalesced']} requests coalesced)  "
+                 f"chain steps {sv['chain_steps']}")
+        occ = sv.get("batch_occupancy")
+        if occ:
+            L.append(f"batch occupancy: mean "
+                     f"{_fmt(occ.get('mean'), 2)}  max "
+                     f"{_fmt(occ.get('max'), 0)}  over "
+                     f"{int(occ.get('count', 0))} wheel(s)")
+        if sv["per_bucket_compiles"]:
+            L.append("per-bucket compiles: " + "  ".join(
+                f"{k}={v}" for k, v in
+                sorted(sv["per_bucket_compiles"].items())))
+        if sv["service_preempted"]:
+            L.append("SERVICE PREEMPTED: in-flight wheels "
+                     "checkpointed; requests resume at next start")
+        L.append("")
+
     inc = incumbent_summary(run)
     if inc is not None:
         L.append("== incumbent ==")
@@ -840,7 +922,8 @@ def render_report(run: Run) -> str:
 
     L.append("== counters ==")
     for k in sorted(c):
-        if k.split(".")[0] in ("ph", "qp", "hub", "spoke", "incumbent"):
+        if k.split(".")[0] in ("ph", "qp", "hub", "spoke", "incumbent",
+                               "serve"):
             L.append(f"  {k} = {_fmt(c[k])}")
     L.append("")
 
@@ -1258,6 +1341,7 @@ def main(argv=None) -> int:
                 "sharding": sharding_summary(run),
                 "incumbent": incumbent_summary(run),
                 "checkpoint": checkpoint_summary(run),
+                "serving": serving_summary(run),
                 "faults": fault_summary(run),
                 "lint": lint_summary(run),
                 "bound_flow": (bf := bound_flow_summary(run)),

@@ -1,0 +1,35 @@
+"""mpisppy_tpu.serve — the persistent stochastic-program serving layer.
+
+The batch CLI (``python -m mpisppy_tpu <model>``) pays trace + compile
++ factorization on every invocation and dies with its wheel. This
+package is the service plane over the same engine (ROADMAP item 2:
+"compile once, serve millions"): one long-lived process
+(``python -m mpisppy_tpu serve --port N --state-dir D``) that
+
+- fingerprints requests into **shape buckets** and keeps one warm
+  jitted engine (+ kernel plan + packed blocks + KKT factorizations)
+  per bucket with LRU eviction (:mod:`.cache`) — the second request of
+  a shape skips XLA compilation entirely,
+- admits requests through a bounded queue with per-request deadlines
+  wired to the PR 5 ``wheel_deadline`` watchdog (:mod:`.queue`),
+- **coalesces data-only instances of one bucket into a single stacked
+  wheel along the scenario axis** (:mod:`.batch`): each request gets
+  its own stage-1 tree root, so consensus never couples tenants and
+  one kernel launch serves the whole group,
+- runs N concurrent wheels with durable per-request ``ckpt/`` bundles
+  as the request-state store (:mod:`.manager`): a preempted (SIGTERM)
+  or killed request resumes through the existing ``--resume-from``
+  machinery instead of failing, and results outlive the connection,
+- serves ``POST /solve`` / ``GET /result/<id>`` / ``GET /queue`` plus
+  the PR 8 ``/metrics`` + ``/status`` endpoints unchanged
+  (:mod:`.http`), and **rolling-horizon chains** as a first-class
+  request type (solve a horizon, commit the head, roll forward
+  warm-started from the previous bundle).
+
+Layering contract (enforced by graft-lint PURE001 + the fresh-
+interpreter import probe): the HTTP/queue/cache/batch plane imports
+WITHOUT jax — only :mod:`.manager` (the wheel runner) touches the
+engine. See doc/serving.md.
+"""
+
+from __future__ import annotations

@@ -1,0 +1,179 @@
+"""The service plane: stdlib HTTP front of the serving layer.
+
+Same construction discipline as the PR 8 live plane (obs/live.py):
+``ThreadingHTTPServer`` + daemon serve thread, loopback bind by
+default (the endpoints accept work and serve full state with no auth —
+``0.0.0.0`` is the explicit opt-in), no jax import anywhere on this
+path (PURE001).
+
+Endpoints:
+
+- ``POST /solve`` — JSON instance (doc/serving.md request schema) ->
+  ``{"request_id": ...}`` (202). 400 on a malformed payload, 429 when
+  the bounded admission queue is full, 503 while preempting.
+- ``GET /result/<id>`` — the durable request record (status,
+  result, error, chain steps). Results outlive the connection AND the
+  process (the store replays from disk).
+- ``GET /queue`` — queued + known requests, light rows.
+- ``GET /metrics`` — the PR 8 Prometheus text exposition of the
+  process-wide Recorder registry, mounted unchanged
+  (obs/live.render_prometheus) plus ``serve.*`` state gauges.
+- ``GET /status`` — the service snapshot: queue depth, request
+  counts, per-wheel hub snapshots (each wheel's PR 8
+  ``Hub.status_snapshot`` with its ``request_tag``), warm-cache
+  anatomy.
+- ``POST /shutdown`` — graceful drain (finish active wheels, keep
+  queued requests durable); ``/healthz`` — liveness.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import obs
+from ..obs.live import render_prometheus
+from .batch import BadRequest
+from .queue import QueueFull
+
+_JSON = "application/json; charset=utf-8"
+_TEXT = "text/plain; charset=utf-8"
+_PROM = "text/plain; version=0.0.4; charset=utf-8"
+_MAX_BODY = 64 * 1024 * 1024
+
+
+def _json_body(code: int, obj) -> tuple:
+    return code, _JSON, (json.dumps(obj, indent=1) + "\n").encode()
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):     # the screen trace is the wheel's
+        pass
+
+    def _reply(self, code, ctype, body):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        try:
+            code, ctype, body = self.server._get(
+                self.path.split("?", 1)[0])
+        except Exception as e:      # introspection must never crash
+            code, ctype = 500, _TEXT
+            body = f"serve error: {e!r}\n".encode()
+        self._reply(code, ctype, body)
+
+    def do_POST(self):
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n > _MAX_BODY:
+                raise BadRequest(f"body over {_MAX_BODY} bytes")
+            raw = self.rfile.read(n) if n else b""
+            code, ctype, body = self.server._post(
+                self.path.split("?", 1)[0], raw)
+        except BadRequest as e:
+            code, ctype, body = _json_body(400, {"error": str(e)})
+        except Exception as e:
+            code, ctype = 500, _TEXT
+            body = f"serve error: {e!r}\n".encode()
+        self._reply(code, ctype, body)
+
+
+class _ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, service, on_shutdown=None):
+        super().__init__(addr, _ServeHandler)
+        self._service = service
+        self._on_shutdown = on_shutdown
+
+    def _get(self, path):
+        obs.counter_add("serve.http_requests")
+        svc = self._service
+        if path.startswith("/result/"):
+            rec = svc.result(path[len("/result/"):])
+            if rec is None:
+                return _json_body(404, {"error": "unknown request id"})
+            return _json_body(200, rec)
+        if path == "/queue":
+            return _json_body(200, svc.queue_snapshot())
+        if path == "/status":
+            return _json_body(200, svc.status_snapshot())
+        if path == "/metrics":
+            rec = obs.active()
+            snap = rec.metrics.snapshot() if rec is not None else None
+            extra = {"serve.queue_depth_now": len(svc.queue),
+                     "serve.wheels_active": len(svc._active_hubs),
+                     "serve.cache_buckets": len(svc.cache)}
+            return (200, _PROM,
+                    render_prometheus(snap, extra_gauges=extra).encode())
+        if path in ("/", "/healthz"):
+            return _json_body(200, {"ok": True,
+                                    "preempting": svc._preempting})
+        return (404, _TEXT, b"unknown path; try /solve /result/<id> "
+                            b"/queue /status /metrics /healthz\n")
+
+    def _post(self, path, raw):
+        obs.counter_add("serve.http_requests")
+        svc = self._service
+        if path == "/solve":
+            if svc._preempting or svc._stop:
+                return _json_body(503, {"error": "service stopping"})
+            try:
+                payload = json.loads(raw.decode("utf-8") or "{}")
+            except ValueError as e:
+                raise BadRequest(f"invalid JSON body: {e}") from None
+            try:
+                req = svc.submit(payload)
+            except QueueFull as e:
+                return _json_body(429, {"error": str(e)})
+            return _json_body(202, {"request_id": req.id,
+                                    "bucket": req.bucket,
+                                    "batchable": req.batchable})
+        if path == "/shutdown":
+            if self._on_shutdown is not None:
+                self._on_shutdown()
+            return _json_body(200, {"ok": True, "stopping": True})
+        return (404, _TEXT, b"unknown POST path; try /solve /shutdown\n")
+
+
+class ServeHTTPServer:
+    """Bind + serve on a daemon thread (port 0 = ephemeral; read
+    ``.port`` after start). Same idempotent start/stop shape as
+    obs/live.LiveStatusServer."""
+
+    def __init__(self, service, port: int, host: str = "127.0.0.1",
+                 on_shutdown=None):
+        self._service = service
+        self._requested = (host, int(port))
+        self._on_shutdown = on_shutdown
+        self._httpd = None
+        self._thread = None
+        self.port = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        self._httpd = _ServeHTTPServer(self._requested, self._service,
+                                       on_shutdown=self._on_shutdown)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mpisppy-tpu-serve", daemon=True)
+        self._thread.start()
+        obs.event("serve.http_server", {"port": self.port})
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

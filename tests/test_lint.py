@@ -424,6 +424,13 @@ def test_jax_free_modules_import_without_jax():
         "import mpisppy_tpu.obs.analyze\n"
         "import mpisppy_tpu.obs.merge\n"
         "import mpisppy_tpu.utils.config\n"
+        # the serving layer's HTTP/queue/cache/batch plane must import
+        # without jax (doc/serving.md layering contract); only
+        # serve/manager — the wheel runner — may touch the engine
+        "import mpisppy_tpu.serve.cache\n"
+        "import mpisppy_tpu.serve.queue\n"
+        "import mpisppy_tpu.serve.batch\n"
+        "import mpisppy_tpu.serve.http\n"
         "import tools.lint.rules\n"
         "import tools.regression_gate\n"
         "print('JAXFREE')\n")

@@ -155,6 +155,14 @@ JAX_FREE_DEFAULT = (
     "mpisppy_tpu/utils/config.py",
     "mpisppy_tpu/testing/faults.py",
     "tools/",
+    # the serving layer's HTTP/queue/cache/batch plane (doc/serving.md
+    # layering contract): only serve/manager.py — the wheel runner —
+    # may touch jax
+    "mpisppy_tpu/serve/__init__.py",
+    "mpisppy_tpu/serve/cache.py",
+    "mpisppy_tpu/serve/queue.py",
+    "mpisppy_tpu/serve/batch.py",
+    "mpisppy_tpu/serve/http.py",
 )
 
 # SYNC001's allowlisted gate sites: functions in hot-loop modules that

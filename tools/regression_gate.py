@@ -15,6 +15,12 @@ violation fails statically in seconds, before any bench cycles, and
 the JSON report lands in the fresh telemetry dir as ``lint.json`` so
 ``analyze`` stamps the compared run with its lint status.
 
+Since ISSUE 13 a serve smoke stage rides last (``--skip-serve-smoke``
+opts out): the serving layer on an ephemeral port, the same farmer
+shape POSTed twice — the second request must hit the warm cache with
+an XLA compile delta of 0 and ``serve.cache.hit`` ≥ 1 on /metrics
+(the compile-once contract, doc/serving.md).
+
 Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
 3 REGRESSION.
 
@@ -102,6 +108,113 @@ def check_checkpoints(ckpt_dir: str) -> int:
     return 0
 
 
+def run_serve_smoke(work_dir: str) -> int:
+    """The ISSUE 13 CI rider: the compile-once serving contract,
+    gated. Starts the serving layer (``python -m mpisppy_tpu serve``)
+    on an ephemeral port with telemetry on, POSTs the same farmer
+    shape twice (different data), and asserts the second wheel hit the
+    warm cache with an XLA compile delta of 0 and ``serve.cache.hit``
+    ≥ 1 on /metrics — the serve twin of the compile-count gate the
+    compare stage applies to the batch wheel."""
+    import json
+    import signal
+    import time
+    import urllib.request
+
+    state = os.path.join(work_dir, "serve_state")
+    tdir = os.path.join(work_dir, "serve_telemetry")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpisppy_tpu", "serve", "--port", "0",
+         "--state-dir", state, "--telemetry-dir", tdir,
+         "--batch-window", "0.05"],
+        cwd=REPO, env=env)
+
+    def _get(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+
+    def _post(url, obj):
+        req = urllib.request.Request(
+            url, data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    try:
+        ep = os.path.join(state, "serve.json")
+        deadline = time.time() + 180
+        port = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                print("regression_gate: serve process died at startup")
+                return 1
+            if os.path.isfile(ep):
+                port = json.load(open(ep, encoding="utf-8"))["port"]
+                break
+            time.sleep(0.2)
+        if port is None:
+            print("regression_gate: serve endpoint file never appeared")
+            return 1
+        base = f"http://127.0.0.1:{port}"
+        payload = {"model": "farmer", "num_scens": 3,
+                   "algo": {"max_iterations": 10}}
+        stamps = []
+        for patch in (None, {"c": {"DevotedAcreage":
+                                   [160.0, 235.0, 250.0]}}):
+            body = dict(payload)
+            if patch:
+                body["patch"] = patch
+            rid = _post(f"{base}/solve", body)["request_id"]
+            # per-request poll budget (not the shared startup
+            # deadline): a slow first compile must not leave the
+            # second request judged on a stale — or unbound — record
+            rec = None
+            poll_end = time.time() + 180
+            while time.time() < poll_end:
+                rec = json.loads(_get(f"{base}/result/{rid}"))
+                if rec["status"] in ("done", "failed"):
+                    break
+                time.sleep(0.25)
+            if rec is None or rec["status"] != "done":
+                print(f"regression_gate: serve request {rid} ended "
+                      f"{(rec or {}).get('status', 'timeout')}: "
+                      f"{(rec or {}).get('error')}")
+                return 1
+            stamps.append(rec["result"]["wheel"])
+        metrics = _get(f"{base}/metrics")
+        if not stamps[1]["cache_hit"]:
+            print("regression_gate: second same-shape request MISSED "
+                  "the warm cache")
+            return 3
+        if stamps[1]["xla_compiles_delta"] != 0:
+            print("regression_gate: COMPILE-ONCE REGRESSION — second "
+                  "same-shape request recompiled "
+                  f"({stamps[1]['xla_compiles_delta']} new XLA "
+                  f"compiles; first request paid "
+                  f"{stamps[0]['xla_compiles_delta']})")
+            return 3
+        hit_line = next((ln for ln in metrics.splitlines()
+                         if ln.startswith("mpisppy_tpu_serve_cache_hit ")),
+                        None)
+        if hit_line is None or float(hit_line.split()[1]) < 1:
+            print("regression_gate: serve.cache.hit missing from "
+                  "/metrics (expected >= 1)")
+            return 3
+        print("regression_gate: serve smoke ok (second request: "
+              "cache hit, compile delta 0)")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="tier-1 perf regression gate "
@@ -125,6 +238,10 @@ def main(argv=None) -> int:
     p.add_argument("--update-golden", action="store_true",
                    help="re-record the golden dir instead of gating "
                         "(commit the result)")
+    p.add_argument("--skip-serve-smoke", action="store_true",
+                   help="skip the serving-layer compile-once smoke "
+                        "stage (doc/serving.md); the bench + compare "
+                        "gate still runs")
     args = p.parse_args(argv)
 
     if args.update_golden:
@@ -190,7 +307,14 @@ def main(argv=None) -> int:
                   f"({args.golden}). If the change is intentional "
                   "(new compile, reshaped phases), re-baseline with "
                   "--update-golden and commit the new golden dir.")
-        return rc
+        if rc != 0:
+            return rc
+        if args.skip_serve_smoke:
+            return rc
+        # serve smoke last (ISSUE 13): the compile-once contract on
+        # the serving layer — same lint-first -> bench -> compare
+        # pipeline, one more stage
+        return run_serve_smoke(fresh)
     finally:
         if args.keep is None:
             shutil.rmtree(fresh, ignore_errors=True)
