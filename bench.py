@@ -502,6 +502,26 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
             rows[0]["checkpoint"] = hub.ckpt.status()
         except Exception:
             pass
+    # progressive-shrinking stamp (ISSUE 14): how far the active set
+    # got — fixed/free slot counts, compaction count, current bucket,
+    # and the est-HBM figure of the compacted shapes. Plain attribute
+    # reads on the engine's host status dict (updated by the device
+    # fixer / maybe_compact), so the SIGTERM flush can stamp it too —
+    # a DNF row records how far shrinking got before the kill.
+    if rows:
+        try:
+            st = getattr(getattr(hub, "opt", None), "_shrink_status",
+                         None)
+            if st:
+                rows[0]["active"] = {
+                    "fixed": st.get("fixed"), "free": st.get("free"),
+                    "compactions": st.get("compactions"),
+                    "bucket": st.get("bucket"),
+                    "est_hbm_bytes_per_iter":
+                        st.get("est_hbm_bytes_per_iter"),
+                }
+        except Exception:
+            pass    # a kill-path flush must never die on diagnostics
     # device incumbent-pool anatomy (ISSUE 9): mode, pool shape, round
     # and improvement counts of the timed window, so the gap row says
     # whether the inner bound came from the device pool or the host
@@ -630,7 +650,7 @@ def _warm_gap_programs(batch, tag):
 def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
                    note, rel_gap=0.004, lag_device_bound=False,
                    xhat_extra=None, lag_extra=None, warm=True,
-                   dive_extra=None):
+                   dive_extra=None, hub_extra=None):
     from mpisppy_tpu.utils.sputils import spin_the_wheel
 
     if warm:
@@ -639,7 +659,7 @@ def _run_gap_wheel(batch, metric_prefix, baseline_s, max_iterations,
     hd, sds = _wheel(batch, lag_device_bound=lag_device_bound,
                      max_iterations=max_iterations, rel_gap=rel_gap,
                      xhat_extra=xhat_extra, lag_extra=lag_extra,
-                     dive_extra=dive_extra)
+                     dive_extra=dive_extra, hub_extra=hub_extra)
     _progress(f"{metric_prefix}: spinning")
     t0 = time.perf_counter()
     inc_mode = None if dive_extra is None \
@@ -722,6 +742,12 @@ def bench_uc1024_gap():
     # were starved by the driver kill.
     _run_gap_wheel(
         batch, "uc1024", baseline_s=0.0, max_iterations=28,
+        # progressive shrinking (ISSUE 14): the device fixer pins
+        # consensus-stable binaries so the gap row's ``active`` block
+        # records the fixed-fraction trajectory (the df32 hub keeps
+        # the pin-boxes path — compaction engages on dense layouts)
+        hub_extra={"shrink_fix": True, "shrink_fix_iters": 4,
+                   "shrink_fix_tol": 1e-3},
         lag_extra={"lagrangian_device_duals": True},
         # consensus-rounded candidates alternate with the oracle
         # plans: the union-of-MILP-plans incumbent over-commits, and

@@ -61,6 +61,33 @@ def make_parser() -> argparse.ArgumentParser:
                         "'segmented' = host-segmented drivers "
                         "bit-for-bit, 'fused' = one device program per "
                         "solve, 'auto' = fused where eligible")
+    # progressive problem shrinking (ops/shrink, doc/extensions.md
+    # §shrinking): device fixer, active-set compaction, per-slot rho
+    p.add_argument("--shrink-fix", action="store_true",
+                   help="device-side WW fixing: jitted per-var "
+                        "convergence counters pin converged nonants "
+                        "(the host Fixer's test-and-fix, zero big-array "
+                        "D2H per iteration)")
+    p.add_argument("--shrink-fix-iters", type=int, default=3,
+                   help="consecutive converged iterations before a "
+                        "nonant slot fixes")
+    p.add_argument("--shrink-fix-tol", type=float, default=1e-4,
+                   help="variance-test tolerance of the device fixer")
+    p.add_argument("--shrink-compact", action="store_true",
+                   help="active-set compaction: gather unfixed "
+                        "columns (and the rows they touch) into a "
+                        "smaller system at bucketed fixed-fraction "
+                        "thresholds (one recompile per bucket "
+                        "transition); implies --shrink-fix semantics")
+    p.add_argument("--shrink-buckets", type=str, default="0.25,0.5,0.75",
+                   help="comma-separated fixed-fraction thresholds for "
+                        "compaction bucket transitions")
+    p.add_argument("--shrink-rho", action="store_true",
+                   help="per-slot device-side adaptive rho "
+                        "(residual-balancing vector rho on the prox "
+                        "diagonal)")
+    p.add_argument("--shrink-rho-interval", type=int, default=1,
+                   help="iterations between per-slot rho update passes")
     p.add_argument("--linearize-proximal-terms", action="store_true")
     p.add_argument("--verbose", action="store_true")
     # termination (ref. baseparsers.py:172 two_sided_args)
@@ -160,6 +187,13 @@ def config_from_args(args) -> RunConfig:
         subproblem_polish_chunk=args.subproblem_polish_chunk,
         subproblem_ir_sweeps=args.subproblem_ir_sweeps,
         subproblem_kernel_mode=args.subproblem_kernel_mode,
+        shrink_fix=args.shrink_fix or args.shrink_compact,
+        shrink_fix_iters=args.shrink_fix_iters,
+        shrink_fix_tol=args.shrink_fix_tol,
+        shrink_compact=args.shrink_compact,
+        shrink_buckets=args.shrink_buckets,
+        shrink_rho=args.shrink_rho,
+        shrink_rho_interval=args.shrink_rho_interval,
         linearize_proximal_terms=args.linearize_proximal_terms,
         verbose=args.verbose,
     )

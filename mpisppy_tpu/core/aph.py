@@ -119,6 +119,11 @@ class APH(PHBase):
 
     def __init__(self, batch, options=None, **kw):
         super().__init__(batch, options, **kw)
+        # active-set compaction (ops/shrink) is a synchronous-PH
+        # mechanic: APH's phi scoring / dispatch pools index the
+        # full-width solve state, so compaction stays off here (the
+        # device fixer's pin-boxes path still works)
+        self._shrink_allowed = False
         o = self.options
         self.nu = float(o.get("APHnu", 1.0))
         self.gamma = float(o.get("APHgamma", 1.0))

@@ -185,10 +185,14 @@ class CrossScenarioPH(PH):
         """Solve every subproblem under the EF objective (own scenario exact
         + eta epigraphs for the rest); each certified dual objective lower-
         bounds the EF optimum, and the MAX over subproblems is returned."""
-        factors, d = self._get_factors(False)
+        # full=True + the width guard below: the EF objective _q_ef is
+        # full-width, and an active shrink plan's cached hot-loop state
+        # would be compacted (core/ph._get_factors)
+        factors, d = self._get_factors(False, full=True)
         st = qp_cold_state(factors, d)
         prev = self._qp_states.get(False)
-        if prev is not None:
+        if prev is not None and prev.x.shape == st.x.shape \
+                and prev.zA.shape == st.zA.shape:
             st = st._replace(x=prev.x, yA=prev.yA, yB=prev.yB,
                              zA=prev.zA, zB=prev.zB)
         # segmented for host-side rho adaptation on untrusted-f64

@@ -175,6 +175,31 @@ def _pool_assemble(lb, ub, l, u, c, c0, vals, pin_mask, idx, sidx, pidx):
     return lb_c, ub_c, l[sidx], u[sidx], c[sidx], c0[sidx]
 
 
+@partial(jax.jit, static_argnames=("w_on",))
+def _shrink_objs(x_full, c, c0, P0, W, idx, *, w_on):
+    """Objectives of an EXPANDED compacted solve (ops/shrink,
+    doc/extensions.md §shrinking): evaluated on the full-width
+    solution block against the FULL cost structures, so base/solved
+    objectives are bit-comparable with the uncompacted path (the fixed
+    columns contribute their folded constants through x_full). The
+    dual bound stays on the compacted system (_shrink_dual)."""
+    xn = x_full[:, idx]
+    base = jnp.sum(c * x_full, axis=1) + c0 \
+        + 0.5 * jnp.sum(P0 * x_full * x_full, axis=1)
+    solved = base + (jnp.sum(W * xn, axis=1) if w_on else 0.0)
+    return xn, base, solved
+
+
+@jax.jit
+def _shrink_dual(d, q, c0_fold, yA, yB, x_c):
+    """Certified dual bound of a compacted solve: qp_dual_objective on
+    the compacted system plus the fixed-variable fold constant — the
+    dual bound of the PINNED full problem, which is exactly what the
+    uncompacted path certifies when the fixer has pinned those boxes
+    (lb = ub makes their dual contribution the same constant)."""
+    return qp_dual_objective(d, q, c0_fold, yA, yB, x_witness=x_c)
+
+
 def _hot_eps(prox_on, sub_eps, sub_eps_hot):
     """The effective primal tolerance of a solve — THE policy both the
     dispatch and any quality gate (chunk recovery) must share."""
@@ -439,6 +464,47 @@ class PHBase(SPBase):
                              " needs dtype=float64 (enable "
                              f"jax_enable_x64); got {self.dtype}")
         self.rho_setter = rho_setter
+        # ---- progressive problem shrinking (ops/shrink,
+        # doc/extensions.md §shrinking) ----
+        self._shrink = None            # active ops/shrink.ShrinkPlan
+        self._shrink_factors = {}      # prox_on -> (factors, data_c)
+        self._shrink_allowed = True    # APH opts out (dispatch pools
+        #                                index full-width state)
+        self._shrink_status = None     # bench/analyze stamp (plain
+        #                                host dict: signal-safe reads)
+        if opts.get("shrink_fix") or opts.get("shrink_compact") \
+                or opts.get("shrink_rho"):
+            if opts.get("shrink_compact") and not opts.get("shrink_fix"):
+                raise ValueError("shrink_compact needs shrink_fix (the "
+                                 "compaction triggers on the device "
+                                 "fixer's fixed-fraction trajectory)")
+            from ..utils.config import parse_shrink_buckets
+            self._shrink_buckets = parse_shrink_buckets(
+                opts.get("shrink_buckets", "0.25,0.5,0.75"))
+            self._shrink_status = {
+                "fixed": 0, "free": batch.K, "compactions": 0,
+                "bucket": 0.0, "n_cols": int(batch.n),
+                "m_rows": int(batch.m),
+                "est_hbm_bytes_per_iter": self._shrink_est_hbm(
+                    int(batch.n), int(batch.m))}
+            # CLI/serve wiring: options carry the knobs but the ctor
+            # got no extension objects — attach the device fixer / rho
+            # updater here so `--shrink-fix` works without programmatic
+            # composition. A caller passing its own extensions owns the
+            # composition (and can include DeviceFixer itself).
+            if extensions is None:
+                from ..extensions.extension import MultiExtension
+                from ..extensions.fixer import DeviceFixer
+                from ..extensions.norm_rho_updater import \
+                    DeviceNormRhoUpdater
+                exts = []
+                if opts.get("shrink_fix"):
+                    exts.append(DeviceFixer(opts))
+                if opts.get("shrink_rho"):
+                    exts.append(DeviceNormRhoUpdater(opts))
+                if exts:
+                    extensions = exts[0] if len(exts) == 1 \
+                        else MultiExtension(exts)
         self.extensions = extensions
         self.converger_cls = converger
         self.converger = None
@@ -576,8 +642,16 @@ class PHBase(SPBase):
         P = d.P_diag.at[:, self.nonant_idx].add(self.rho)
         return d._replace(P_diag=P)
 
-    def _get_factors(self, prox_on: bool, fixed: bool = False):
+    def _get_factors(self, prox_on: bool, fixed: bool = False,
+                     full: bool = False):
         """Cached per-mode factorization (invalidated on rho change).
+
+        ``full=True`` bypasses an active shrink plan: consumers whose
+        operands are built FULL-width against ``self.c`` /
+        ``self.batch.n`` (the integer dive, the cross-scenario EF
+        bound) must pair them with full factors even while the hot
+        loop solves the compacted system — the ``_factors`` cache they
+        land in is the full-system cache, untouched by shrink mode.
 
         ``fixed=True`` builds factors for fully-pinned-nonant solves
         (incumbent evaluation, Benders cut generation): the nonant boxes
@@ -585,6 +659,14 @@ class PHBase(SPBase):
         eq-boosted for those columns or the solve crawls. The boost pattern
         depends only on WHICH columns are pinned, not the pinned values,
         so one factorization serves every candidate x̂."""
+        if not fixed and not full and self._shrink is not None:
+            # hot-loop modes solve the COMPACTED system while a shrink
+            # plan is active (doc/extensions.md §shrinking); fixed-mode
+            # solves (incumbent eval, cut generation) keep the full
+            # system — they pin every nonant anyway, so the active-set
+            # win does not apply and their factor cache stays
+            # bucket-stable for the serving layer.
+            return self._shrink_get_factors(prox_on)
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         if key not in self._factors:
             from ..ops.qp_solver import (ScaledView, SplitMatrix,
@@ -681,6 +763,9 @@ class PHBase(SPBase):
     def invalidate_factors(self):
         """Call after changing rho (rho setters / NormRhoUpdater)."""
         self._kernel_plans.clear()   # plans hold views of the factors
+        # compacted factors carry the prox rho too (ops/shrink); the
+        # prox-off entry survives a rho change like the full cache's
+        self._shrink_factors.pop(True, None)
         for cache in (self._factors, self._qp_states):
             cache.pop(True, None)
             cache.pop(("fixed", True), None)
@@ -702,6 +787,175 @@ class PHBase(SPBase):
         self._pool_states.clear()
         self._pool_dirty.clear()
 
+    # ------------- active-set compaction (ops/shrink) -------------
+    def _shrink_get_factors(self, prox_on: bool):
+        """Cached factorization of the COMPACTED system — one
+        re-factorization per (bucket transition, mode), exactly the
+        budget the issue allows. Kept in a separate cache from
+        ``_factors`` so the serving layer's install-refresh loop (which
+        rebuilds FULL data snapshots) never pairs a compacted factor
+        with full data."""
+        key = bool(prox_on)
+        if key not in self._shrink_factors:
+            plan = self._shrink
+            d = plan.data_c
+            if prox_on:
+                if d.P_diag.ndim == 1:
+                    # shared single-factor form: per-slot rho is fine
+                    # (vector add), per-SCENARIO rho is not
+                    rho_np = np.asarray(self.rho)   # lint: ok[SYNC001] factor-(re)build path: once per compaction x mode, not per solve
+                    if not (rho_np == rho_np[:1]).all():
+                        raise ValueError(
+                            "active-set compaction of a shared-"
+                            "structure batch requires rho uniform "
+                            "across scenarios (per-slot vector rho is "
+                            "supported; per-scenario rho is not)")
+                    rho_c = jnp.asarray(rho_np[0], self.dtype)[
+                        plan.free_slots_dev]
+                    d = d._replace(
+                        P_diag=d.P_diag.at[plan.idx_c].add(rho_c))
+                else:
+                    # batched per-scenario quadratic: rho adds per row
+                    d = d._replace(P_diag=d.P_diag.at[:, plan.idx_c].add(
+                        self.rho[:, plan.free_slots_dev]))
+            fac = qp_setup(d, q_ref=plan.c_c)
+            self._shrink_factors[key] = (fac, d)
+        return self._shrink_factors[key]
+
+    def _shrink_dual_fold(self, shrink, w_on, prox_on):
+        """The per-iteration dual-bound constant of the compacted
+        system (ops/shrink.dual_fold): base fold + this iteration's
+        W / prox-center contributions of the folded slots."""
+        from ..ops.shrink import dual_fold
+        fsx = shrink.fixed_slots_dev
+        ws = None if self._w_scale is None else self._w_scale[:, fsx]
+        return dual_fold(shrink.c0_fold, self._fixed_vals[:, fsx],
+                         self.W[:, fsx], self.xbar[:, fsx],
+                         self.rho[:, fsx], ws, w_on=bool(w_on),
+                         prox_on=bool(prox_on))
+
+    def _shrink_est_hbm(self, n, m):
+        """Roofline traffic estimate for the CURRENT active-set shapes
+        (ops/kernels.est_hbm_bytes_per_iter's tail model) — the number
+        the ph.iteration shrink block and the bench ``active=`` stamp
+        record, so analyze can show per-iteration bytes tracking the
+        active set."""
+        from ..ops import kernels
+        chunk = int(self.options.get("subproblem_chunk", 0)) \
+            or self.batch.S
+        return int(kernels.est_hbm_bytes_per_iter(
+            n=n, m=m, s_chunk=min(chunk, self.batch.S))["tail"])
+
+    def maybe_compact(self, nfixed=None):
+        """Active-set compaction trigger (called by DeviceFixer after
+        each fixing pass): when the fixed fraction crosses the next
+        ``shrink_buckets`` threshold, gather the unfixed columns (and
+        the rows they touch) into a smaller packed system, re-factorize
+        once, and solve THAT until the next transition. Returns True
+        when a compaction happened. No-op unless ``shrink_compact`` is
+        enabled and the engine's structure supports it (shared dense A;
+        the df32 split representation keeps the pin-boxes path)."""
+        if not bool(self.options.get("shrink_compact")):
+            return False
+        if nfixed is None:
+            # lint: ok[SYNC001] compaction trigger outside the fixer: one (S, K) mask read per call, never in the chunk chain
+            nfixed = int(np.asarray(self._fixed_mask).all(axis=0).sum())
+        st = self._shrink_status
+        if st is not None:
+            st["fixed"], st["free"] = int(nfixed), \
+                self.batch.K - int(nfixed)
+        frac = nfixed / max(self.batch.K, 1)
+        crossed = [b for b in self._shrink_buckets if b <= frac]
+        target = crossed[-1] if crossed else None
+        current = self._shrink.bucket if self._shrink is not None else 0.0
+        if target is None or target <= current:
+            return False
+        if not self._shrink_allowed \
+                or not isinstance(self.qp_data.A, jax.Array) \
+                or getattr(self.qp_data.A, "ndim", 0) not in (2, 3):
+            # df32 SplitMatrix / ScaledView / packed layouts: the
+            # compacted gather is not defined for them (yet) — fixing
+            # still pays off through the pin boxes. Booked once per
+            # TARGET bucket (the layout stays unsupported every
+            # iteration; a per-call count would tally iterations)
+            noted = getattr(self, "_shrink_skip_noted", None)
+            if noted is None:
+                noted = self._shrink_skip_noted = set()
+            if target not in noted:
+                noted.add(target)
+                obs.counter_add("shrink.compaction_skipped")
+            return False
+        from ..ops import shrink as shrink_ops
+        noted = getattr(self, "_shrink_skip_noted", None)
+        if noted is None:
+            noted = self._shrink_skip_noted = set()
+        if target in noted:
+            # a plan for this target already failed (all slots fixed /
+            # no rows left): build_plan's host staging must not re-run
+            # every miditer — the once-per-transition contract
+            return False
+        plan = shrink_ops.build_plan(
+            self.qp_data, self.c, self.c0, self.nonant_idx,
+            self._fixed_mask, self._fixed_vals, target,
+            dtype=self.dtype,
+            ident={"kernel_mode": self.sub_kernel_mode,
+                   "precision": self.sub_precision,
+                   "chunk": int(self.options.get("subproblem_chunk",
+                                                 0))})
+        if plan is None:
+            noted.add(target)
+            obs.counter_add("shrink.compaction_skipped")
+            return False
+        self._shrink = plan
+        self._compact_invalidate()
+        obs.counter_add("shrink.compactions")
+        obs.gauge_set("shrink.active_cols", plan.n_c)
+        obs.gauge_set("shrink.active_rows", plan.m_c)
+        if st is not None:
+            st["compactions"] += 1
+            st["bucket"] = plan.bucket
+            st["n_cols"], st["m_rows"] = plan.n_c, plan.m_c
+            st["est_hbm_bytes_per_iter"] = self._shrink_est_hbm(
+                plan.n_c, plan.m_c)
+        obs.event("shrink.compaction", {
+            "iter": self._iter, "bucket": plan.bucket,
+            "fingerprint": plan.fingerprint,
+            "n_cols": plan.n_c, "m_rows": plan.m_c,
+            "n_full": plan.n_full, "m_full": plan.m_full,
+            "fixed_slots": plan.n_fixed_slots,
+            "bucket_cached": plan.meta.get("bucket_cached", False)})
+        self._trace_note(
+            "shrink.note",
+            f"shrink: compacted to bucket {plan.bucket:g} — "
+            f"{plan.n_c}/{plan.n_full} cols, {plan.m_c}/{plan.m_full} "
+            f"rows ({plan.n_fixed_slots} nonants folded out)",
+            bucket=plan.bucket, n_cols=plan.n_c, m_rows=plan.m_c)
+        return True
+
+    def _compact_invalidate(self):
+        """A bucket transition changes every hot-loop solve shape:
+        drop all warm state (compacted iterates of the OLD shape can't
+        warm-start the new one — states rebuild cold, and the
+        near-converged problem re-converges in a handful of ADMM
+        iterations), the compacted factor cache, kernel plans, chunk
+        plumbing, and recovery bookkeeping. The FULL-system factor
+        cache (``_factors``) survives: a transition changes only the
+        compacted representation — (A, P, rho) of the full system are
+        untouched, and the full=True / fixed-mode consumers (dive,
+        cross-scenario, incumbent eval) would otherwise pay a full
+        re-factorization per transition for nothing."""
+        self._shrink_factors.clear()
+        self._qp_states.clear()
+        self._kernel_plans.clear()
+        self._chunk_no_retry.clear()
+        self._hospital_no_retry.clear()
+        self._blacklist_calls.clear()
+        self._chunk_donatable.clear()
+        self._chunk_dirty.clear()
+        getattr(self, "_chunk_idx_cache", {}).clear()
+        self._pool_states.clear()
+        self._pool_dirty.clear()
+
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
         x/y/z warm-start across modes. Always returns a genuine QPState:
@@ -714,8 +968,16 @@ class PHBase(SPBase):
         st = self._qp_states.get(key)
         if isinstance(st, _ChunkStateView):
             factors, d = self._get_factors(prox_on, fixed)
-            st = qp_cold_state(factors, d)._replace(
-                x=st.x, yA=st.yA, yB=st.yB, zA=st.zA, zB=st.zB)
+            cold = qp_cold_state(factors, d)
+            if st.x.shape[-1] == cold.x.shape[-1] \
+                    and st.zA.shape[-1] == cold.zA.shape[-1]:
+                st = cold._replace(
+                    x=st.x, yA=st.yA, yB=st.yB, zA=st.zA, zB=st.zB)
+            else:
+                # a shrink-era view's precomputed x is EXPANDED while
+                # its iterates are compacted — width mismatch means
+                # the warm start is not transplantable; start cold
+                st = cold
             self._qp_states[key] = st
             return st
         if key not in self._qp_states:
@@ -810,7 +1072,12 @@ class PHBase(SPBase):
             oth_ch = None
             transplant = other is not None \
                 and other.x.shape[0] == self.batch.S \
-                and other.zA.shape[1] == st0.zA.shape[1]
+                and other.zA.shape[1] == st0.zA.shape[1] \
+                and other.x.shape[-1] == st0.x.shape[-1]
+            #   (the width check matters under compaction: a shrink
+            #   view's precomputed x is EXPANDED to full width while
+            #   its solver states are compacted — full iterates must
+            #   never transplant into a compacted cold state)
             if transplant and chunks is not None:
                 oth_ch = self._shard_ops.to_chunks(
                     {"x": other.x, "yA": other.yA, "yB": other.yB,
@@ -857,18 +1124,40 @@ class PHBase(SPBase):
                 for ci in range(n_chunks)]
         return self._chunk_idx_cache[key]
 
-    def _chunked_inputs(self, data, lc):
+    def _chunked_inputs(self, data, lc, shrink=None, c0fold=None):
         """Every per-scenario operand of one chunked sharded pass,
         restaged as (n_chunks, lc*n_dev, ...) sharded arrays in ONE
         jitted local reshape — no per-chunk device_put, no host
-        threads; ``chs[name][ci]`` is chunk ci's sharded slice."""
+        threads; ``chs[name][ci]`` is chunk ci's sharded slice.
+
+        With an active shrink plan the assemble-side operands are the
+        COMPACTED system (data is already compacted by
+        _shrink_get_factors; the (S, K) hub blocks gather to the free
+        slots), while the objective-side operands stay FULL width
+        (``cF``/``WF``) — pass 3 expands each chunk's solution before
+        evaluating them, so objectives remain bit-comparable with the
+        uncompacted wheel."""
         per_scen = {"l": data.l, "u": data.u, "lb": data.lb,
-                    "ub": data.ub, "c": self.c, "c0": self.c0,
-                    "P0": self.P_diag, "W": self.W, "xbar": self.xbar,
-                    "rho": self.rho, "fm": self._fixed_mask,
-                    "fv": self._fixed_vals}
-        if self._w_scale is not None:
-            per_scen["ws"] = self._w_scale
+                    "ub": data.ub, "c0": self.c0, "P0": self.P_diag}
+        if shrink is None:
+            per_scen.update(
+                {"c": self.c, "W": self.W, "xbar": self.xbar,
+                 "rho": self.rho, "fm": self._fixed_mask,
+                 "fv": self._fixed_vals})
+            if self._w_scale is not None:
+                per_scen["ws"] = self._w_scale
+        else:
+            fs = shrink.free_slots_dev
+            per_scen.update(
+                {"c": shrink.c_c, "W": self.W[:, fs],
+                 "xbar": self.xbar[:, fs], "rho": self.rho[:, fs],
+                 "fm": self._fixed_mask[:, fs],
+                 "fv": self._fixed_vals[:, fs],
+                 "cF": self.c, "WF": self.W,
+                 "c0fold": c0fold,
+                 "fvcols": shrink.fixed_colvals})
+            if self._w_scale is not None:
+                per_scen["ws"] = self._w_scale[:, fs]
         return self._shard_ops.to_chunks(per_scen, lc)
 
     def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed):
@@ -917,15 +1206,37 @@ class PHBase(SPBase):
                 "(every scenario must carry the same A and P; "
                 "per-scenario matrices need per-scenario factors and "
                 "gain nothing from chunking)")
+        # active-set compaction (ops/shrink): hot-loop modes solve the
+        # compacted system (data/factors above are already compacted);
+        # the (S, K) hub blocks gather to the free slots for assembly
+        # and pass 3 expands solutions back to full width
+        shrink = self._shrink if not fixed else None
+        idx_asm = shrink.idx_c if shrink is not None else self.nonant_idx
+        c0fold = None if shrink is None else self._shrink_dual_fold(
+            shrink, w_on, prox_on)
         ops = self._shard_ops
         sharded = ops is not None
         if sharded:
             lc = self._local_chunk(chunk)
             slices = self._sharded_chunk_slices(lc)
-            chs = self._chunked_inputs(data, lc)
+            chs = self._chunked_inputs(data, lc, shrink=shrink,
+                                       c0fold=c0fold)
         else:
             lc, chs = None, None
             slices = self._chunk_index(chunk)
+            if shrink is not None:
+                fs = shrink.free_slots_dev
+                a_c, a_W = shrink.c_c, self.W[:, fs]
+                a_xbar, a_rho = self.xbar[:, fs], self.rho[:, fs]
+                a_fm = self._fixed_mask[:, fs]
+                a_fv = self._fixed_vals[:, fs]
+                a_ws = None if self._w_scale is None \
+                    else self._w_scale[:, fs]
+            else:
+                a_c, a_W, a_xbar, a_rho = (self.c, self.W, self.xbar,
+                                           self.rho)
+                a_fm, a_fv = self._fixed_mask, self._fixed_vals
+                a_ws = self._w_scale
         self._drop_if_dirty(key)
         fresh_states = ("chunks", key) not in self._qp_states
         states = self._ensure_chunk_states(key, factors, data, slices,
@@ -1005,18 +1316,18 @@ class PHBase(SPBase):
                 ws = chs["ws"][ci] if "ws" in chs else None
                 q_c, bl_c, bu_c = _ph_assemble(
                     d_c, chs["c"][ci], chs["W"][ci], chs["xbar"][ci],
-                    chs["rho"][ci], self.nonant_idx, chs["fm"][ci],
+                    chs["rho"][ci], idx_asm, chs["fm"][ci],
                     chs["fv"][ci], ws, w_on=bool(w_on),
                     prox_on=bool(prox_on))
                 return d_c._replace(lb=bl_c, ub=bu_c), q_c
             idx_c, _ = slices[ci]
             d_c = data._replace(l=data.l[idx_c], u=data.u[idx_c],
                                 lb=data.lb[idx_c], ub=data.ub[idx_c])
-            ws = None if self._w_scale is None else self._w_scale[idx_c]
+            ws = None if a_ws is None else a_ws[idx_c]
             q_c, bl_c, bu_c = _ph_assemble(
-                d_c, self.c[idx_c], self.W[idx_c], self.xbar[idx_c],
-                self.rho[idx_c], self.nonant_idx,
-                self._fixed_mask[idx_c], self._fixed_vals[idx_c], ws,
+                d_c, a_c[idx_c], a_W[idx_c], a_xbar[idx_c],
+                a_rho[idx_c], idx_asm,
+                a_fm[idx_c], a_fv[idx_c], ws,
                 w_on=bool(w_on), prox_on=bool(prox_on))
             return d_c._replace(lb=bl_c, ub=bu_c), q_c
 
@@ -1227,7 +1538,13 @@ class PHBase(SPBase):
         # capped and only ever runs on the few flagged scenarios.
         from ..ops.qp_solver import ScaledView
         if bool(self.options.get("subproblem_hospital", True)) \
+                and shrink is None \
                 and not isinstance(data.A, (SplitMatrix, ScaledView)):
+            # (compacted passes skip the hospital: it re-assembles from
+            # the FULL cost/W blocks against per-scenario factors — a
+            # compacted spelling is future work; stragglers rely on the
+            # chunk retries + blacklist re-admission, which run on the
+            # compacted system unchanged)
             # the hospital builds per-scenario (cap, m, n) batched
             # factors — structurally impossible at the scale df32
             # exists for (one (n, n) f64 host inversion there costs
@@ -1276,16 +1593,41 @@ class PHBase(SPBase):
             st, x, yA, yB = solved_chunks[ci][:4]
             d_h, q_h = solved_chunks[ci][4], solved_chunks[ci][5]
             states[ci] = st
-            if sharded:
-                c_c, c0_c, P0_c, W_c = (chs["c"][ci], chs["c0"][ci],
-                                        chs["P0"][ci], chs["W"][ci])
+            if shrink is not None:
+                # expand the compacted solution to full width (fixed
+                # columns take their folded values) and evaluate the
+                # objectives against the FULL cost structures; the
+                # dual bound stays on the compacted system + fold
+                from ..ops.shrink import expand_solution
+                if sharded:
+                    fvc, cF_c, WF_c = (chs["fvcols"][ci], chs["cF"][ci],
+                                       chs["WF"][ci])
+                    c0_c, P0_c = chs["c0"][ci], chs["P0"][ci]
+                    c0f_c = chs["c0fold"][ci]
+                else:
+                    fvc = shrink.fixed_colvals[idx_c]
+                    cF_c, WF_c = self.c[idx_c], self.W[idx_c]
+                    c0_c, P0_c = self.c0[idx_c], self.P_diag[idx_c]
+                    c0f_c = c0fold[idx_c]
+                x = expand_solution(x, fvc, shrink.keep_cols,
+                                    shrink.fixed_cols, cF_c[0])
+                xn, base, solved = _shrink_objs(
+                    x, cF_c, c0_c, P0_c, WF_c, self.nonant_idx,
+                    w_on=bool(w_on))
+                dual = _shrink_dual(d_h, q_h, c0f_c, yA, yB,
+                                    solved_chunks[ci][1])
             else:
-                c_c, c0_c, P0_c, W_c = (self.c[idx_c], self.c0[idx_c],
-                                        self.P_diag[idx_c],
-                                        self.W[idx_c])
-            xn, base, solved, dual = _ph_chunk_objs(
-                x, yA, yB, d_h, q_h, c_c, c0_c, P0_c, self.nonant_idx,
-                W_c, w_on=bool(w_on))
+                if sharded:
+                    c_c, c0_c, P0_c, W_c = (chs["c"][ci], chs["c0"][ci],
+                                            chs["P0"][ci], chs["W"][ci])
+                else:
+                    c_c, c0_c, P0_c, W_c = (self.c[idx_c],
+                                            self.c0[idx_c],
+                                            self.P_diag[idx_c],
+                                            self.W[idx_c])
+                xn, base, solved, dual = _ph_chunk_objs(
+                    x, yA, yB, d_h, q_h, c_c, c0_c, P0_c,
+                    self.nonant_idx, W_c, w_on=bool(w_on))
             for k, v in (("x", x[:real]), ("yA", yA[:real]),
                          ("yB", yB[:real]), ("xn", xn[:real]),
                          ("base", base[:real]), ("solved", solved[:real]),
@@ -1435,7 +1777,13 @@ class PHBase(SPBase):
                             # verdict row reads these
                             "kernel.fused_iters",
                             "kernel.l_inv_factorizations",
-                            "kernel.bf16_fallbacks")
+                            "kernel.bf16_fallbacks",
+                            # progressive shrinking (ops/shrink): newly
+                            # fixed slots and bucket transitions THIS
+                            # iteration — analyze's shrinking section
+                            # reads these off the record stream
+                            "shrink.fixed_new",
+                            "shrink.compactions")
 
     def iteration_record(self, it, seconds, phase_before, counters_before):
         """The structured per-iteration convergence record (the
@@ -1465,6 +1813,12 @@ class PHBase(SPBase):
         res = self.residual_summary(True)
         if res is not None:
             rec.update(res)
+        if self._shrink_status is not None:
+            # the active-set trajectory (doc/extensions.md §shrinking):
+            # plain host-dict copy, updated by the device fixer and
+            # maybe_compact — analyze's shrinking section plots
+            # fixed-fraction, bucket, and est-HBM against s/iter
+            rec["shrink"] = dict(self._shrink_status)
         now = self._phase_totals()
         rec["phase_seconds"] = {k: now[k] - phase_before.get(k, 0.0)
                                 for k in now}
@@ -1696,6 +2050,84 @@ class PHBase(SPBase):
             t_mark = now
 
         combine_fn = sh.combine if sh is not None else None
+
+        shrink = self._shrink if not fixed else None
+        if shrink is not None:
+            # compacted fused step (ops/shrink): assemble on the
+            # gathered free-slot blocks, solve the compacted system,
+            # expand, then reduce on the FULL blocks — the reduce math
+            # (and therefore W/xbar/conv) is the uncompacted path's
+            from ..ops.shrink import expand_solution
+            fs = shrink.free_slots_dev
+            ws = None if self._w_scale is None else self._w_scale[:, fs]
+            q_c, bl_c, bu_c = _ph_assemble(
+                data, shrink.c_c, self.W[:, fs], self.xbar[:, fs],
+                self.rho[:, fs], shrink.idx_c,
+                self._fixed_mask[:, fs], self._fixed_vals[:, fs], ws,
+                w_on=bool(w_on), prox_on=bool(prox_on))
+            d_c = data._replace(lb=bl_c, ub=bu_c)
+            _lap("assemble")
+            qp_state, x_c, yA, yB = _solver_call(
+                factors, d_c, q_c, qp_state, prox_on=bool(prox_on),
+                precision=self.sub_precision,
+                sub_max_iter=self.sub_max_iter, sub_eps=self.sub_eps,
+                sub_eps_hot=self.sub_eps_hot,
+                sub_eps_dua_hot=self.sub_eps_dua_hot,
+                tail_iter=self.sub_tail_iter,
+                stall_rel=self.sub_stall_rel, segment=self.sub_segment,
+                polish_hot=self.sub_polish_hot,
+                polish_chunk=int(self.options.get(
+                    "subproblem_polish_chunk", 0)),
+                segment_lo=self.sub_segment_lo,
+                ir_sweeps=self.sub_ir_sweeps, kernel=plan)
+            if plan.mode == "fused":
+                if obs.enabled():
+                    obs.counter_add("kernel.fused_iters",
+                                    int(qp_state.iters))
+                # phase honesty (see _ph_step): the fused wait must
+                # land inside the solve lap
+                # lint: ok[SYNC001] phase honesty for fused plans, same site contract as _ph_step
+                jax.block_until_ready(qp_state.pri_rel)
+            _lap("solve")
+            x = expand_solution(x_c, shrink.fixed_colvals,
+                                shrink.keep_cols, shrink.fixed_cols,
+                                self.c[0])
+            xn, base_obj, solved_obj = _shrink_objs(
+                x, self.c, self.c0, self.P_diag, self.W,
+                self.nonant_idx, w_on=bool(w_on))
+            dual_obj = _shrink_dual(
+                d_c, q_c, self._shrink_dual_fold(shrink, w_on, prox_on),
+                yA, yB, x_c)
+            wmask = None if self._w_scale is None else self._w_scale > 0
+            if combine_fn is None:
+                xbar_new, xsqbar_new, W_new, conv = _ph_combine(
+                    xn, self.prob, self.xbar_weights,
+                    tuple(self.memberships), self.W, self.rho, wmask,
+                    slot_slices=self.slot_bounds)
+            else:
+                xbar_new, xsqbar_new, W_new, conv = combine_fn(
+                    xn, self.prob, self.xbar_weights, self.W, self.rho,
+                    wmask)
+            _lap("reduce")
+            self._qp_states[skey] = qp_state
+            self.x, self.yA, self.yB = x, yA, yB
+            if update:
+                self.xbar, self.xsqbar = xbar_new, xsqbar_new
+                self.W_new = W_new
+                # lint: ok[SYNC001] THE per-iteration convergence scalar readback — the one designed sync (doc/pipelining.md)
+                self.conv = float(conv)
+                obs.gauge_set("ph.conv", self.conv)
+            self._last_base_obj = base_obj
+            self._last_solved_obj = solved_obj
+            self._last_dual_obj = dual_obj
+            if self._timing:
+                # lint: ok[SYNC001] opt-in timing sync (report_timing), off by default
+                jax.block_until_ready(x)
+                self._solve_times.setdefault(
+                    (bool(w_on), bool(prox_on), bool(fixed)), []).append(
+                    _time.perf_counter() - t0)
+            self._ext("post_solve")
+            return solved_obj
 
         (qp_state, x, yA, yB, xn, xbar_new, xsqbar_new, W_new, conv,
          base_obj, solved_obj, dual_obj) = _ph_step(
@@ -1990,13 +2422,21 @@ class PHBase(SPBase):
             xn = self._hub_nonants() if X is None else jnp.asarray(X)
             return np.asarray(xn), np.ones(self.batch.S, bool)
         prox_on = X is not None
-        factors, d = self._get_factors(prox_on)
+        # full=True: the dive's q/imask are built full-width against
+        # self.c — while a shrink plan is active the hot-loop factors
+        # are compacted and would mismatch (see _get_factors)
+        factors, d = self._get_factors(prox_on, full=True)
         if prox_on:
             q = self.c.at[:, self.nonant_idx].add(
                 -self.rho * jnp.asarray(X, self.dtype))
         else:
             q = self.c
-        st = self._ensure_state(prox_on)
+        if self._shrink is None:
+            st = self._ensure_state(prox_on)
+        else:
+            # the cached hot-loop state is compacted — dive from a
+            # full-width cold state instead of clobbering it
+            st = qp_cold_state(factors, d)
         # aggressiveness knobs for reference-scale dives (VERDICT r4
         # #5): pin_frac=2 pins half the remaining columns per round
         # (~11 rounds on 4320 commitments vs ~60 at the default 8);

@@ -12,16 +12,16 @@ instance itself.
 """
 
 from .extension import Extension, MultiExtension
-from .fixer import Fixer, FixerTuple
+from .fixer import DeviceFixer, Fixer, FixerTuple
 from .mipgapper import Gapper
-from .norm_rho_updater import NormRhoUpdater
+from .norm_rho_updater import DeviceNormRhoUpdater, NormRhoUpdater
 from .xhatclosest import XhatClosest
 from .diagnoser import Diagnoser
 from .avgminmaxer import MinMaxAvg
 from .wxbar_io import WXBarWriter, WXBarReader
 
 __all__ = [
-    "Extension", "MultiExtension", "Fixer", "FixerTuple", "Gapper",
-    "NormRhoUpdater", "XhatClosest", "Diagnoser", "MinMaxAvg",
-    "WXBarWriter", "WXBarReader",
+    "Extension", "MultiExtension", "Fixer", "FixerTuple", "DeviceFixer",
+    "Gapper", "NormRhoUpdater", "DeviceNormRhoUpdater", "XhatClosest",
+    "Diagnoser", "MinMaxAvg", "WXBarWriter", "WXBarReader",
 ]

@@ -58,6 +58,11 @@ class Fixer(Extension):
         super().__init__(options)
         self._init_done = False
 
+    def reset(self):
+        """Forget per-run state (see DeviceFixer.reset)."""
+        self._init_done = False
+        self.nfixed = 0
+
     def _setup(self, opt):
         K = opt.batch.K
         fct = self.options.get("id_fix_list_fct", None)
@@ -126,3 +131,105 @@ class Fixer(Extension):
     def post_everything(self, opt):
         if self._init_done and opt.options.get("verbose"):
             print(f"Fixer: final fixed count {self.nfixed}")
+
+
+class DeviceFixer(Extension):
+    """The Fixer's test-and-fix as ONE jitted op over the hub's (S, K)
+    device state (ops/shrink.fixer_update, ROADMAP item 5): per-slot
+    consecutive-converged counters, bound-parking votes, and the
+    accumulated fix mask live ON DEVICE — no per-``miditer`` D2H of
+    xbar/xsqbar/x (the host Fixer pulled all three every pass). The
+    host reads ONE scalar (the fixed-slot count) per iteration, after
+    the PH step's existing convergence sync has already materialized
+    the arrays — a copy, not a pipeline stall.
+
+    With ``shrink_compact`` enabled the fixed-count trajectory also
+    drives :meth:`PHBase.maybe_compact` — active-set compaction at the
+    bucketed thresholds (doc/extensions.md §shrinking).
+
+    options (engine options or a dedicated dict): ``id_fix_list_fct``
+    (same contract as Fixer), ``boundtol``, ``shrink_fix_iters``
+    (default threshold when no fix-list fct is given),
+    ``shrink_fix_tol``."""
+
+    def __init__(self, options=None):
+        super().__init__(options)
+        self._init_done = False
+        self.nfixed = 0
+
+    def reset(self):
+        """Forget per-run state (serve install_batch calls this when a
+        warm engine is re-leased to a new tenant): counters, streaks,
+        and the latched slot bounds all re-derive from the NEW batch
+        on the next ``_setup``."""
+        self._init_done = False
+        self.nfixed = 0
+
+    def _setup(self, opt):
+        import jax.numpy as jnp
+        K = opt.batch.K
+        fct = self.options.get("id_fix_list_fct", None)
+        if fct is not None:
+            spec = fct(opt.batch)
+        else:
+            it = int(self.options.get("shrink_fix_iters", 3))
+            spec = uniform_fix_list(
+                opt.batch, tol=float(self.options.get("shrink_fix_tol",
+                                                      1e-4)),
+                nb=it, lb=it, ub=it)
+        t = opt.dtype
+        from ..ops import shrink as shrink_ops
+        clip = lambda a: np.minimum(np.asarray(a, np.int64),
+                                    shrink_ops.INT_NEVER)
+        self._tol = jnp.asarray(spec["tol"], t)
+        self._nbc = jnp.asarray(clip(spec["nb"]))
+        self._lbc = jnp.asarray(clip(spec["lb"]))
+        self._ubc = jnp.asarray(clip(spec["ub"]))
+        self._boundtol = float(self.options.get("boundtol", 1e-6))
+        z = jnp.zeros(K, jnp.int32)
+        self._conv_count, self._lb_count, self._ub_count = z, z, z
+        idx = np.asarray(opt.batch.nonant_idx)
+        self._slot_lb = jnp.asarray(np.asarray(opt.batch.lb)[:, idx], t)
+        self._slot_ub = jnp.asarray(np.asarray(opt.batch.ub)[:, idx], t)
+        self._imask = jnp.asarray(opt.nonant_integer_mask)
+        self._init_done = True
+
+    def post_iter0(self, opt):
+        if not self._init_done:
+            self._setup(opt)
+
+    def miditer(self, opt):
+        from ..ops import shrink as shrink_ops
+        if not self._init_done:
+            self._setup(opt)
+        (self._conv_count, self._lb_count, self._ub_count,
+         fixed_mask, fixed_vals, n_fixed) = shrink_ops.fixer_update(
+            self._conv_count, self._lb_count, self._ub_count,
+            opt._fixed_mask, opt._fixed_vals, opt.xbar, opt.xsqbar,
+            opt._hub_nonants(), self._slot_lb, self._slot_ub,
+            self._tol, self._boundtol, self._nbc, self._lbc, self._ubc,
+            self._imask)
+        # the ONE host scalar of the pass: rides the iteration's conv
+        # sync (the arrays are already materialized), drives the fix
+        # event + the compaction trigger. The (S, K) mask/values stay
+        # on device end to end — fix_nonants consumes device arrays.
+        nf = int(n_fixed)
+        if nf > self.nfixed:
+            opt.fix_nonants(fixed_vals, mask=fixed_mask)
+            from .. import obs
+            obs.counter_add("shrink.fixed_new", nf - self.nfixed)
+            obs.gauge_set("shrink.fixed_fraction", nf / opt.batch.K)
+            obs.event("shrink.fix", {"iter": opt._iter, "fixed": nf,
+                                     "free": opt.batch.K - nf})
+            if opt.options.get("verbose"):
+                print(f"DeviceFixer: {nf}/{opt.batch.K} nonants fixed "
+                      f"at iter {opt._iter}")
+        self.nfixed = nf
+        st = getattr(opt, "_shrink_status", None)
+        if st is not None:
+            st["fixed"], st["free"] = nf, opt.batch.K - nf
+        opt.maybe_compact(nf)
+
+    def post_everything(self, opt):
+        if self._init_done and opt.options.get("verbose"):
+            print(f"DeviceFixer: final fixed count {self.nfixed}")

@@ -6,17 +6,33 @@ primal residual ‖x − x̄‖ (prob-weighted, reduced over scenarios) and the
 dual residual ρ‖x̄ − x̄_prev‖; multiply rho by ``rho_update_factor`` when
 primal > mult·dual, divide when dual > mult·primal.
 
-The residuals here are whole-vector norms computed from the already-device-
-resident xbar/x tensors; updating rho invalidates the engine's cached KKT
-factorization (rho sits on the prox diagonal).
+Two spellings:
+
+- :class:`NormRhoUpdater` — the reference-shaped WHOLE-VECTOR update
+  (one scalar factor on the whole rho block).
+- :class:`DeviceNormRhoUpdater` — the per-SLOT device-side update
+  (ops/shrink.per_slot_rho_update, ROADMAP item 5): each nonant slot
+  balances its own residual pair, producing a vector rho on the prox
+  diagonal. rho stays uniform across scenarios, so the engine's
+  single-factor prox path keeps serving it; every applied update
+  invalidates the cached KKT factorization exactly like the scalar
+  spelling (rho sits on the prox diagonal).
+
+Residual histories are bounded deques (``history_cap``, default 512):
+the old unbounded lists leaked host memory on serve-hosted and
+rolling-horizon wheels that run for days.
 """
 
 from __future__ import annotations
+
+from collections import deque
 
 import jax.numpy as jnp
 import numpy as np
 
 from .extension import Extension
+
+HISTORY_CAP_DEFAULT = 512
 
 
 class NormRhoUpdater(Extension):
@@ -26,8 +42,17 @@ class NormRhoUpdater(Extension):
         self.mult = float(o.get("primal_dual_mult", 10.0))
         self.factor = float(o.get("rho_update_factor", 2.0))
         self.verbose = bool(o.get("verbose", False))
+        cap = int(o.get("history_cap", HISTORY_CAP_DEFAULT))
         self._prev_xbar = None
-        self.prim_hist, self.dual_hist = [], []
+        self.prim_hist = deque(maxlen=cap)
+        self.dual_hist = deque(maxlen=cap)
+
+    def reset(self):
+        """Forget per-run state (serve install_batch calls this when a
+        warm engine is re-leased to a new tenant)."""
+        self._prev_xbar = None
+        self.prim_hist.clear()
+        self.dual_hist.clear()
 
     def miditer(self, opt):
         xn = opt._hub_nonants()
@@ -54,3 +79,74 @@ class NormRhoUpdater(Extension):
             if self.verbose:
                 print(f"NormRhoUpdater it {opt._iter}: rho /= {self.factor} "
                       f"(prim {prim:.3e} dual {dual:.3e})")
+
+
+class DeviceNormRhoUpdater(Extension):
+    """Per-slot residual balancing as ONE jitted op. The host pays a
+    single tiny (3,) D2H per update pass ([changed, prim_sum,
+    dual_sum] — the history samples ride it, they are not separate
+    reads) instead of the whole-vector spelling's three big-array
+    pulls. ``shrink_rho_interval`` rate-limits update passes: every
+    APPLIED update invalidates the factor cache, and a per-iteration
+    refactorization can cost more than the stepsize win on small
+    models.
+
+    options: ``primal_dual_mult``, ``rho_update_factor``,
+    ``shrink_rho_interval`` (or ``update_interval``), ``history_cap``.
+    Compatible with the ``adaptive_rho=False`` incumbent-pool path by
+    construction — that knob freezes the SOLVER's internal rho_scale
+    trajectory, while this extension moves the engine-level prox rho
+    between iterations (the two never meet inside one solve)."""
+
+    def __init__(self, options=None):
+        super().__init__(options)
+        o = self.options.get("norm_rho_options", self.options)
+        self.mult = float(o.get("primal_dual_mult", 10.0))
+        self.factor = float(o.get("rho_update_factor", 2.0))
+        self.interval = int(o.get("shrink_rho_interval",
+                                  o.get("update_interval", 1)))
+        self.verbose = bool(o.get("verbose", False))
+        cap = int(o.get("history_cap", HISTORY_CAP_DEFAULT))
+        self._prev_xbar = None
+        self.prim_hist = deque(maxlen=cap)
+        self.dual_hist = deque(maxlen=cap)
+        self.updates = 0
+
+    def reset(self):
+        """Forget per-run state (serve install_batch calls this when a
+        warm engine is re-leased to a new tenant)."""
+        self._prev_xbar = None
+        self.prim_hist.clear()
+        self.dual_hist.clear()
+        self.updates = 0
+
+    def miditer(self, opt):
+        # _prev_xbar refreshes EVERY miditer (a device reference, no
+        # D2H), not only on update passes: the dual residual must span
+        # one iteration like the primal one, or an interval > 1 would
+        # compare an interval-accumulated dual against a single-step
+        # primal and bias the balance toward shrinking rho
+        prev, self._prev_xbar = self._prev_xbar, opt.xbar
+        if prev is None:
+            return
+        if self.interval > 1 and opt._iter % self.interval:
+            return
+        from ..ops import shrink as shrink_ops
+        new_rho, stats = shrink_ops.per_slot_rho_update(
+            opt.rho, opt.prob, opt._hub_nonants(), opt.xbar,
+            prev, self.mult, self.factor)
+        st = np.asarray(stats)     # the ONE (3,) D2H of the pass
+        self.prim_hist.append(float(st[1]))
+        self.dual_hist.append(float(st[2]))
+        if st[0] > 0:
+            opt.rho = new_rho
+            opt.invalidate_factors()
+            self.updates += 1
+            from .. import obs
+            obs.counter_add("shrink.rho_updates")
+            obs.event("shrink.rho", {"iter": opt._iter,
+                                     "prim_sum": float(st[1]),
+                                     "dual_sum": float(st[2])})
+            if self.verbose:
+                print(f"DeviceNormRhoUpdater it {opt._iter}: per-slot "
+                      f"rho update (prim {st[1]:.3e} dual {st[2]:.3e})")

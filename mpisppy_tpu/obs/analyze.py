@@ -347,6 +347,68 @@ def incumbent_summary(run: Run) -> dict | None:
     }
 
 
+def shrink_summary(run: Run) -> dict | None:
+    """Progressive-shrinking activity (ops/shrink, doc/extensions.md
+    §shrinking): the fixed-fraction trajectory off the per-iteration
+    records' ``shrink`` blocks, compaction events, per-bucket s/iter
+    means, and the est-HBM drop — the ISSUE 14 acceptance evidence
+    that per-iteration cost tracks the ACTIVE set. None when shrinking
+    never ran."""
+    tot = {}
+    for role in run.metrics:
+        for k, v in run.counters(role).items():
+            if k.startswith("shrink."):
+                tot[k] = tot.get(k, 0) + v
+    compactions = run.of("shrink.compaction")
+    fixes = run.of("shrink.fix")
+    rows = [e for e in iteration_rows(run) if e.get("shrink")]
+    if not tot and not compactions and not fixes and not rows:
+        return None
+    traj = [{"iter": e["iter"],
+             "fixed": e["shrink"].get("fixed"),
+             "free": e["shrink"].get("free"),
+             "bucket": e["shrink"].get("bucket"),
+             "seconds": e.get("seconds"),
+             "est_hbm_bytes_per_iter":
+                 e["shrink"].get("est_hbm_bytes_per_iter")}
+            for e in rows]
+    # per-bucket s/iter: group the record stream by the bucket active
+    # when each iteration ran — the post-compaction drop is the win
+    per_bucket = {}
+    for t in traj:
+        if isinstance(t.get("seconds"), (int, float)):
+            b = t.get("bucket") or 0.0
+            per_bucket.setdefault(b, []).append(t["seconds"])
+    bucket_rows = [
+        {"bucket": b, "iters": len(v), "s_per_iter": sum(v) / len(v),
+         "est_hbm_bytes_per_iter": next(
+             (t["est_hbm_bytes_per_iter"] for t in traj
+              if (t.get("bucket") or 0.0) == b
+              and t.get("est_hbm_bytes_per_iter") is not None), None)}
+        for b, v in sorted(per_bucket.items())]
+    return {
+        "fixed_final": (traj[-1]["fixed"] if traj else None),
+        "free_final": (traj[-1]["free"] if traj else None),
+        "fixed_new_total": int(tot.get("shrink.fixed_new", 0)),
+        "compactions": int(tot.get("shrink.compactions", 0))
+        or len(compactions),
+        "compaction_skipped": int(tot.get("shrink.compaction_skipped",
+                                          0)),
+        "rho_updates": int(tot.get("shrink.rho_updates", 0)),
+        "bucket_compiles": int(tot.get("shrink.bucket.compile", 0)),
+        "bucket_cache_hits": int(tot.get("shrink.bucket.cache_hit", 0)),
+        "compaction_events": [
+            {"iter": e.get("iter"), "bucket": e.get("bucket"),
+             "n_cols": e.get("n_cols"), "m_rows": e.get("m_rows"),
+             "n_full": e.get("n_full"), "m_full": e.get("m_full"),
+             "fingerprint": e.get("fingerprint"),
+             "bucket_cached": e.get("bucket_cached")}
+            for e in compactions],
+        "per_bucket": bucket_rows,
+        "trajectory": traj,
+    }
+
+
 def checkpoint_summary(run: Run) -> dict | None:
     """Durable checkpoint activity (mpisppy_tpu.ckpt,
     doc/fault_tolerance.md): ``ckpt.*`` counters summed across roles
@@ -901,6 +963,41 @@ def render_report(run: Run) -> str:
                      "checkpointed; requests resume at next start")
         L.append("")
 
+    shr = shrink_summary(run)
+    if shr is not None:
+        L.append("== shrinking ==")
+        L.append(f"fixed {shr['fixed_final']} / free {shr['free_final']}"
+                 f"  (+{shr['fixed_new_total']} fixed over the run)  "
+                 f"compactions {shr['compactions']}"
+                 + (f" (skipped {shr['compaction_skipped']})"
+                    if shr['compaction_skipped'] else "")
+                 + f"  rho updates {shr['rho_updates']}")
+        if shr["compactions"]:
+            L.append(f"bucket compiles {shr['bucket_compiles']}  "
+                     f"bucket cache hits {shr['bucket_cache_hits']}")
+            for e in shr["compaction_events"]:
+                L.append(f"  iter {e['iter']}: bucket {e['bucket']:g} "
+                         f"-> {e['n_cols']}/{e['n_full']} cols, "
+                         f"{e['m_rows']}/{e['m_full']} rows"
+                         + (" [cached]" if e.get("bucket_cached")
+                            else ""))
+        if shr["per_bucket"]:
+            L.append("per-bucket s/iter (active-set verdict source):")
+            for b in shr["per_bucket"]:
+                hbm = b.get("est_hbm_bytes_per_iter")
+                L.append(f"  bucket {b['bucket']:g}: "
+                         f"{_fmt(b['s_per_iter'], 4)} s/iter over "
+                         f"{b['iters']} iter(s)"
+                         + (f", est HBM {_fmt_b(hbm)}/iter"
+                            if hbm else ""))
+        tr = [t for t in shr["trajectory"]
+              if t.get("fixed") is not None]
+        if tr:
+            L.append("fixed-fraction trajectory (iter: fixed/free): "
+                     + "  ".join(f"{t['iter']}: {t['fixed']}/{t['free']}"
+                                 for t in tr[-8:]))
+        L.append("")
+
     inc = incumbent_summary(run)
     if inc is not None:
         L.append("== incumbent ==")
@@ -923,7 +1020,7 @@ def render_report(run: Run) -> str:
     L.append("== counters ==")
     for k in sorted(c):
         if k.split(".")[0] in ("ph", "qp", "hub", "spoke", "incumbent",
-                               "serve"):
+                               "serve", "shrink"):
             L.append(f"  {k} = {_fmt(c[k])}")
     L.append("")
 
@@ -1116,6 +1213,33 @@ def compare(a: Run, b: Run, threshold=1.5,
             f"l_inv={kb['l_inv_factorizations']}, "
             f"bf16_fallbacks={kb['bf16_fallbacks']}) — "
             f"per-iteration verdict [{tag}]")
+    # per-iteration-time-vs-active-set verdict row (ISSUE 14,
+    # doc/extensions.md §shrinking): for a run with compactions, the
+    # shrinking promise is that post-compaction iterations get
+    # CHEAPER as the active set shrinks — restate each side's
+    # per-bucket s/iter as one explicit line. A side whose
+    # last-bucket mean runs >1.5x its bucket-0 mean (over the abs
+    # floor) broke the promise and books a regression.
+    for tag, run_ in (("A", a), ("B", b)):
+        sh = shrink_summary(run_)
+        if sh is None or not sh.get("per_bucket"):
+            continue
+        pb = sh["per_bucket"]
+        head, tail = pb[0], pb[-1]
+        line = "  ".join(
+            f"bucket {r['bucket']:g}={_fmt(r['s_per_iter'], 4)}s/iter"
+            f"({r['iters']})" for r in pb)
+        verdict = "PASS"
+        if len(pb) > 1 and tail["s_per_iter"] > head["s_per_iter"] \
+                * threshold \
+                and (tail["s_per_iter"] - head["s_per_iter"]) \
+                > abs_floor:
+            verdict = "REGRESSION"
+            regressions.append(f"shrink_active_set[{tag}]")
+        if len(pb) > 1:
+            line += (f" — active-set verdict [{verdict}] "
+                     f"(compactions {sh['compactions']})")
+        L.append(f"  shrink[{tag}]: {line}")
     only = [k[0] for k in (set(ma) ^ set(mb))]
     if only:
         L.append(f"  (not in both runs, skipped: {sorted(only)})")
@@ -1320,6 +1444,8 @@ def main(argv=None) -> int:
                            for k, v in comparison_metrics(b).items()},
                      "kernel": {"a": kernel_summary(a),
                                 "b": kernel_summary(b)},
+                     "shrink": {"a": shrink_summary(a),
+                                "b": shrink_summary(b)},
                      "verdict": "PASS" if passed else "REGRESSION"}))
             else:
                 print(text)
@@ -1339,6 +1465,7 @@ def main(argv=None) -> int:
                 "compile": {k: v for k, v in compile_summary(run).items()
                             if k != "entries"},
                 "sharding": sharding_summary(run),
+                "shrink": shrink_summary(run),
                 "incumbent": incumbent_summary(run),
                 "checkpoint": checkpoint_summary(run),
                 "serving": serving_summary(run),

@@ -26,9 +26,21 @@ Deliberate scope (the production tiling plan lives in doc/kernels.md):
    rule needs a refactorization the kernel cannot express) — the
    driver folds ``state.rho_scale`` into the row patterns and the
    reference path handles adaptation between blocks;
- - no grid: one program instance owns the whole chunk. Production
-   tiling splits the scenario axis over the grid with the operator
-   broadcast — shapes here are test-scale.
+ - SCENARIO-AXIS GRID TILING (the production tiling item of
+   doc/kernels.md, landed): per-scenario operands (q/l/u/lb/ub and the
+   five iterate blocks) split into ``scen_tile``-row blocks over a 1-D
+   grid while the shared operands (A, the solve operator, scalings)
+   broadcast to every program instance — so a COMPACTED block
+   (ops/shrink: small K after active-set compaction) keeps its whole
+   working set VMEM-resident per tile instead of spilling the full
+   scenario axis. Scenario rows are independent through the entire
+   iteration block (A/F are shared; projections, dual updates, and the
+   residual maxima are row-local), so tiling is exact — the parity
+   test pins tiled == untiled bit-for-bit under interpret mode.
+   ``scen_tile=None`` picks the largest divisor of S at or under
+   SCEN_TILE_TARGET (S itself when S is small); ``scen_tile=0``
+   disables tiling (one program instance owns the whole chunk, the
+   pre-tiling behavior).
 """
 
 from __future__ import annotations
@@ -47,7 +59,28 @@ except Exception:  # pragma: no cover - environment without pallas
     pl = None
     HAVE_PALLAS = False
 
-__all__ = ["HAVE_PALLAS", "pallas_supported", "fused_admm_block"]
+__all__ = ["HAVE_PALLAS", "pallas_supported", "fused_admm_block",
+           "pick_scen_tile", "SCEN_TILE_TARGET"]
+
+# target rows per grid tile: small enough that a tile's iterate
+# working set stays VMEM-resident beside the shared operator at
+# compacted-block sizes, large enough to keep the MXU matmuls square-
+# ish. Power of two on purpose (the chunked loop's row counts are).
+SCEN_TILE_TARGET = 128
+
+
+def pick_scen_tile(S: int, target: int = SCEN_TILE_TARGET) -> int:
+    """Largest divisor of S that is <= target (pallas grids need exact
+    tiling — padding the scenario axis would fabricate rows whose
+    residual maxima pollute the fused reduction). S itself when S is
+    already at or under the target; 1-row tiles only for prime S."""
+    S = int(S)
+    if S <= target:
+        return S
+    for tile in range(target, 1, -1):
+        if S % tile == 0:
+            return tile
+    return 1    # prime S: row tiles
 
 
 def pallas_supported(factors, state) -> bool:
@@ -128,10 +161,10 @@ def _admm_block_kernel(A_ref, F_ref, Ps_ref, g_ref, q_ref,
 
 @partial(jax.jit,
          static_argnames=("sigma", "n_steps", "alpha", "interpret",
-                          "l_inv_pair"))
+                          "l_inv_pair", "scen_tile"))
 def _block_call(A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
                 Einv, Ebinv, Dinv_c, D, x, yA, yB, zA, zB, sigma,
-                n_steps, alpha, interpret, l_inv_pair):
+                n_steps, alpha, interpret, l_inv_pair, scen_tile=0):
     S, n = x.shape
     m = zA.shape[1]
     dt = x.dtype
@@ -144,14 +177,50 @@ def _block_call(A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
                  jax.ShapeDtypeStruct((S, n), dt),   # zB
                  jax.ShapeDtypeStruct((S,), dt),     # pri
                  jax.ShapeDtypeStruct((S,), dt)]     # dua
-    return pl.pallas_call(kern, out_shape=out_shape,
-                          interpret=interpret)(
-        A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
-        Einv, Ebinv, Dinv_c, D, x, yA, yB, zA, zB)
+    operands = (A, F, Ps, g, q_s, l_s, u_s, lb_s, ub_s, rA, rB,
+                Einv, Ebinv, Dinv_c, D, x, yA, yB, zA, zB)
+    if not scen_tile or scen_tile >= S:
+        # one program instance owns the whole chunk
+        return pl.pallas_call(kern, out_shape=out_shape,
+                              interpret=interpret)(*operands)
+    # scenario-axis grid (doc/kernels.md production tiling): shared
+    # operands broadcast (index map pinned at block 0), per-scenario
+    # operands and ALL outputs tile the leading axis. Scenario rows
+    # are independent through the whole iteration block, so this is
+    # exact — no halo, no cross-tile reduction.
+    assert S % scen_tile == 0, "scen_tile must divide the chunk rows"
+    grid = (S // scen_tile,)
+
+    def shared(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, lambda i, _n=nd: (0,) * _n)
+
+    def scen(shape):
+        nd = len(shape)
+        return pl.BlockSpec((scen_tile,) + shape[1:],
+                            lambda i, _n=nd: (i,) + (0,) * (_n - 1))
+
+    def scaling(arr):
+        # factor scalings are (m,)/(n,) for shared factorizations; a
+        # batched spelling (rank 2, leading S) tiles with the rows
+        return scen(arr.shape) if arr.ndim == 2 else shared(arr.shape)
+
+    in_specs = [shared(A.shape), shared(F.shape), scaling(Ps),
+                scaling(g), scen(q_s.shape), scen(l_s.shape),
+                scen(u_s.shape), scen(lb_s.shape), scen(ub_s.shape),
+                scaling(rA), scaling(rB), scaling(Einv),
+                scaling(Ebinv), scaling(Dinv_c),
+                scaling(D), scen(x.shape), scen(yA.shape),
+                scen(yB.shape), scen(zA.shape), scen(zB.shape)]
+    out_specs = [scen((S, n)), scen((S, m)), scen((S, n)),
+                 scen((S, m)), scen((S, n)), scen((S,)), scen((S,))]
+    return pl.pallas_call(kern, out_shape=out_shape, grid=grid,
+                          in_specs=in_specs, out_specs=out_specs,
+                          interpret=interpret)(*operands)
 
 
 def fused_admm_block(factors, data, q, state, n_steps, interpret=None,
-                     sigma=None):
+                     sigma=None, scen_tile=None):
     """Run ``n_steps`` fused ADMM iterations on the scaled problem
     (factors, data, q) from ``state``; returns (x, yA, yB, zA, zB,
     pri, dua) — SCALED iterates (the QPState carry convention) plus the
@@ -162,7 +231,11 @@ def fused_admm_block(factors, data, q, state, n_steps, interpret=None,
     ``sigma``: the host float of ``factors.sigma`` (a compile-time
     constant of the kernel). kernel_solve passes the plan's copy read
     once at prepare() time; the fallback below is for direct callers
-    (parity tests) and pays one scalar D2H per block."""
+    (parity tests) and pays one scalar D2H per block.
+
+    ``scen_tile``: rows per grid tile over the scenario axis (None =
+    pick_scen_tile's auto choice, 0 = untiled single program — see the
+    module docstring; tiling is exact, pinned by the parity test)."""
     if interpret is None:
         # tier-1 coverage without a chip: interpret everywhere but TPU
         interpret = jax.default_backend() != "tpu"
@@ -179,10 +252,12 @@ def fused_admm_block(factors, data, q, state, n_steps, interpret=None,
     L = state.L
     l_inv_pair = isinstance(L, LInv)
     F = L.inv if l_inv_pair else L
+    if scen_tile is None:
+        scen_tile = pick_scen_tile(state.x.shape[0])
     return _block_call(factors.A_s, F, factors.P_s, g, q_s,
                        l_s, u_s, lb_s, ub_s, rA, rB, Einv, Ebinv,
                        Dinv_c, factors.D, state.x, state.yA, state.yB,
                        state.zA, state.zB, sigma=sigma,
                        n_steps=int(n_steps), alpha=1.6,
                        interpret=bool(interpret),
-                       l_inv_pair=l_inv_pair)
+                       l_inv_pair=l_inv_pair, scen_tile=int(scen_tile))

@@ -124,6 +124,40 @@ def install_batch(engine, stacked):
     # tenant's iterates/scales, blacklists its pathology — drop them
     # (cold states rebuild through the already-compiled jitted
     # builders); factors/plans stay
+    # active-set compaction state is PER-TENANT: the folded constants
+    # bake the previous request's rhs/cost values, so the plan (and
+    # its separately cached compacted factors) must drop with the
+    # install — the next tenant's fixer re-accumulates and re-compacts
+    # against ITS data. Bucket fingerprints include the shrink knobs,
+    # so shrink-on and shrink-off requests never share a lease.
+    if getattr(engine, "_shrink", None) is not None:
+        engine._shrink = None
+    if hasattr(engine, "_shrink_factors"):
+        engine._shrink_factors.clear()
+    if getattr(engine, "_shrink_skip_noted", None):
+        # tenant A's noted skip targets must not mute tenant B's
+        # shrink.compaction_skipped bookings
+        engine._shrink_skip_noted.clear()
+    if getattr(engine, "_shrink_status", None) is not None:
+        engine._shrink_status.update(
+            {"fixed": 0, "free": K, "compactions": 0, "bucket": 0.0,
+             "n_cols": int(b.n), "m_rows": int(b.m),
+             # full-width estimate again — leaving the previous
+             # tenant's compacted figure would stamp wrong est-HBM
+             # evidence on the next tenant's bucket-0 iterations
+             "est_hbm_bytes_per_iter": engine._shrink_est_hbm(
+                 int(b.n), int(b.m))})
+    # per-run EXTENSION state is per-tenant too: the device fixer's
+    # streak counters / latched slot bounds and the rho updaters'
+    # prox-center history would otherwise leak the previous tenant's
+    # trajectory into the next wheel (near-threshold streaks fixing
+    # after one iteration, bound parks pinning at stale bounds)
+    ext = getattr(engine, "extensions", None)
+    for e in ([ext] if ext is not None else []) \
+            + list(getattr(ext, "extensions", []) or []):
+        r = getattr(e, "reset", None)
+        if callable(r):
+            r()
     engine._qp_states.clear()
     engine._pool_states.clear()
     engine._pool_dirty.clear()

@@ -49,6 +49,29 @@ INCUMBENT_MODES = ("device", "oracle", "auto")
 KNOWN_HUBS = ("ph", "aph", "lshaped")
 
 
+def parse_shrink_buckets(spec) -> tuple:
+    """``shrink_buckets`` knob -> strictly increasing fractions in
+    (0, 1). Accepts the CLI's comma-separated string or any iterable
+    of numbers. Defined HERE (jax-free) like the kernel constants:
+    AlgoConfig validation, the serve payload whitelist, and the
+    jax-touching ops/shrink module all read one parser."""
+    if isinstance(spec, str):
+        parts = [p for p in (s.strip() for s in spec.split(",")) if p]
+        vals = tuple(float(p) for p in parts)
+    else:
+        vals = tuple(float(v) for v in spec)
+    if not vals:
+        raise ValueError("shrink_buckets must name at least one "
+                         "threshold fraction")
+    if any(not (0.0 < v < 1.0) for v in vals):
+        raise ValueError(f"shrink_buckets fractions must lie in (0, 1); "
+                         f"got {vals}")
+    if list(vals) != sorted(set(vals)):
+        raise ValueError(f"shrink_buckets must be strictly increasing; "
+                         f"got {vals}")
+    return vals
+
+
 @dataclass
 class AlgoConfig:
     """Engine options (the PHoptions analog)."""
@@ -75,6 +98,17 @@ class AlgoConfig:
     # chunks + fused quality-gate sync + donated warm starts; 0 opts
     # back into the strictly sequential debug loop
     subproblem_pipeline: int = 1
+    # ---- progressive problem shrinking (ops/shrink, doc/extensions.md
+    # §shrinking): device-side WW fixing counters, active-set
+    # compaction, per-slot adaptive rho ----
+    shrink_fix: bool = False        # jitted per-var convergence counters
+    shrink_fix_iters: int = 3       # consecutive converged iterations
+    shrink_fix_tol: float = 1e-4    # variance-test tolerance
+    shrink_compact: bool = False    # active-set compaction at bucket
+    #                                 thresholds (requires shrink_fix)
+    shrink_buckets: str = "0.25,0.5,0.75"   # fixed-fraction thresholds
+    shrink_rho: bool = False        # per-slot device-side adaptive rho
+    shrink_rho_interval: int = 1    # iterations between rho updates
     linearize_proximal_terms: bool = False   # accepted + ignored (see ph.py)
     verbose: bool = False
 
@@ -93,6 +127,17 @@ class AlgoConfig:
             "subproblem_kernel_block_dtype":
                 self.subproblem_kernel_block_dtype,
             "subproblem_pipeline": self.subproblem_pipeline,
+            # shrink_* knobs ride to_options() so they reach the engine
+            # AND the serve bucket fingerprint (serve/batch.bucket_key
+            # hashes algo.to_options(): shrink-enabled and
+            # shrink-disabled requests never share a leased engine)
+            "shrink_fix": self.shrink_fix,
+            "shrink_fix_iters": self.shrink_fix_iters,
+            "shrink_fix_tol": self.shrink_fix_tol,
+            "shrink_compact": self.shrink_compact,
+            "shrink_buckets": self.shrink_buckets,
+            "shrink_rho": self.shrink_rho,
+            "shrink_rho_interval": self.shrink_rho_interval,
             "verbose": self.verbose,
         }
 
@@ -124,6 +169,17 @@ class AlgoConfig:
                 f"unknown subproblem_kernel_block_dtype "
                 f"{self.subproblem_kernel_block_dtype!r}; known: "
                 f"{KERNEL_BLOCK_DTYPES}")
+        if self.shrink_fix_iters < 1:
+            raise ValueError("shrink_fix_iters must be >= 1")
+        if self.shrink_fix_tol <= 0:
+            raise ValueError("shrink_fix_tol must be positive")
+        if self.shrink_rho_interval < 1:
+            raise ValueError("shrink_rho_interval must be >= 1")
+        if self.shrink_compact and not self.shrink_fix:
+            raise ValueError("shrink_compact needs shrink_fix (the "
+                             "compaction triggers on the device fixer's "
+                             "fixed-fraction trajectory)")
+        parse_shrink_buckets(self.shrink_buckets)
         # the combined rule (ISSUE 7 small fix): an explicitly-fused
         # kernel unrolls the IR sweeps statically — out-of-band counts
         # must fail here with a clear error, not as a deep jit failure.

@@ -143,6 +143,7 @@ HOT_LOOP_DEFAULT = (
     "mpisppy_tpu/ops/qp_solver.py",
     "mpisppy_tpu/ops/kernels/",
     "mpisppy_tpu/ops/incumbent.py",
+    "mpisppy_tpu/ops/shrink.py",
     "mpisppy_tpu/parallel/mesh.py",
 )
 
@@ -248,6 +249,12 @@ SYNC_ALLOW_DEFAULT = {
         "make_mesh": "mesh construction, once per engine",
         "pad_batch_for_mesh":
             "zero-probability padding at engine build, setup-time",
+    },
+    "mpisppy_tpu/ops/shrink.py": {
+        "build_plan":
+            "compaction planning is host+eager once per BUCKET "
+            "TRANSITION by documented contract (one fixed-mask read + "
+            "one row-pattern read, never per iteration)",
     },
 }
 

@@ -21,6 +21,14 @@ shape POSTed twice — the second request must hit the warm cache with
 an XLA compile delta of 0 and ``serve.cache.hit`` ≥ 1 on /metrics
 (the compile-once contract, doc/serving.md).
 
+Since ISSUE 14 the compare stage also renders the
+per-iteration-time-vs-active-set verdict row (``shrink[A/B]: bucket
+... s/iter — active-set verdict``) for any side whose wheel ran
+progressive shrinking (ops/shrink): a run whose post-compaction
+buckets iterate SLOWER than bucket 0 by more than the time threshold
+books a regression like any other compare row. The golden farmer
+bench runs shrink-free, so the row is absent there by construction.
+
 Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
 3 REGRESSION.
 
