@@ -522,6 +522,19 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
                 }
         except Exception:
             pass    # a kill-path flush must never die on diagnostics
+    # scenario-streaming stamp (ISSUE 15): which source fed the wheel
+    # and how much it staged — plain host-dict reads on the source's
+    # status (updated by the staging paths), so the SIGTERM flush can
+    # stamp it too; a DNF row says whether the wheel was shipping or
+    # synthesizing when it died
+    if rows:
+        try:
+            src = getattr(getattr(hub, "opt", None), "_stream_source",
+                          None)
+            if src is not None:
+                rows[0]["stream"] = src.status()
+        except Exception:
+            pass    # a kill-path flush must never die on diagnostics
     # device incumbent-pool anatomy (ISSUE 9): mode, pool shape, round
     # and improvement counts of the timed window, so the gap row says
     # whether the inner bound came from the device pool or the host

@@ -31,7 +31,7 @@ import sys
 
 from .utils.config import (AlgoConfig, RunConfig, SpokeConfig, KNOWN_MODELS,
                            KNOWN_SPOKES, KNOWN_HUBS, KERNEL_MODES,
-                           INCUMBENT_MODES)
+                           INCUMBENT_MODES, STREAM_SOURCES)
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -88,6 +88,35 @@ def make_parser() -> argparse.ArgumentParser:
                         "diagonal)")
     p.add_argument("--shrink-rho-interval", type=int, default=1,
                    help="iterations between per-slot rho update passes")
+    # scenario streaming (mpisppy_tpu/stream, doc/streaming.md)
+    p.add_argument("--scenario-source", choices=STREAM_SOURCES,
+                   default="resident",
+                   help="where the chunked hot loop's per-scenario "
+                        "vector blocks come from (doc/streaming.md): "
+                        "'resident' = full-width device arrays, "
+                        "'streamed' = host store + double-buffered H2D "
+                        "chunk pipeline, 'synthesized' = device-side "
+                        "seeded generation (models exporting "
+                        "scenario_synth_spec; zero steady-state "
+                        "transfer). Non-resident sources need "
+                        "--subproblem-chunk and run hub-only")
+    p.add_argument("--stream-int8", action="store_true",
+                   help="int8 delta-packed host storage for the "
+                        "streamed source (explicit opt-in behind a "
+                        "host-side quantization gate, like the bf16 "
+                        "packed blocks — doc/streaming.md)")
+    p.add_argument("--stream-int8-tol", type=float, default=1e-3,
+                   help="int8 gate: max per-entry reconstruction error "
+                        "relative to 1+|value| before a field falls "
+                        "back to full-precision storage")
+    p.add_argument("--stream-depth", type=int, default=2,
+                   help="prefetch pipeline depth (staged chunks; 2 = "
+                        "double buffering)")
+    p.add_argument("--subproblem-chunk", type=int, default=None,
+                   help="scenario microbatch rows per device solve "
+                        "call (the chunked hot loop; required by "
+                        "non-resident --scenario-source). Lands in "
+                        "hub_options like the programmatic spelling")
     p.add_argument("--linearize-proximal-terms", action="store_true")
     p.add_argument("--verbose", action="store_true")
     # termination (ref. baseparsers.py:172 two_sided_args)
@@ -194,9 +223,16 @@ def config_from_args(args) -> RunConfig:
         shrink_buckets=args.shrink_buckets,
         shrink_rho=args.shrink_rho,
         shrink_rho_interval=args.shrink_rho_interval,
+        scenario_source=args.scenario_source,
+        stream_int8=args.stream_int8,
+        stream_int8_tol=args.stream_int8_tol,
+        stream_depth=args.stream_depth,
         linearize_proximal_terms=args.linearize_proximal_terms,
         verbose=args.verbose,
     )
+    hub_options = {}
+    if args.subproblem_chunk is not None:
+        hub_options["subproblem_chunk"] = args.subproblem_chunk
     spokes = [SpokeConfig(kind=k) for k in KNOWN_SPOKES
               if getattr(args, f"with_{k}")]
     # build the dict whenever ANY coordinator flag is present, so
@@ -215,6 +251,7 @@ def config_from_args(args) -> RunConfig:
         model=args.model, num_scens=args.num_scens,
         model_kwargs=json.loads(args.model_kwargs),
         num_bundles=args.num_bundles, hub=args.hub, algo=algo,
+        hub_options=hub_options,
         spokes=spokes, rel_gap=args.rel_gap, abs_gap=args.abs_gap,
         incumbent_mode=args.incumbent_mode,
         solve_ef=args.solve_ef, ef_integer=args.ef_integer,

@@ -871,11 +871,16 @@ class PHBase(SPBase):
         if target is None or target <= current:
             return False
         if not self._shrink_allowed \
+                or self._stream_source is not None \
                 or not isinstance(self.qp_data.A, jax.Array) \
                 or getattr(self.qp_data.A, "ndim", 0) not in (2, 3):
             # df32 SplitMatrix / ScaledView / packed layouts: the
             # compacted gather is not defined for them (yet) — fixing
-            # still pays off through the pin boxes. Booked once per
+            # still pays off through the pin boxes. Streamed/
+            # synthesized sources skip too (build_plan folds FULL-width
+            # data constants the engine deliberately never ships;
+            # AlgoConfig.validate already rejects the CLI combination —
+            # this guards programmatic options). Booked once per
             # TARGET bucket (the layout stays unsupported every
             # iteration; a per-call count would tally iterations)
             noted = getattr(self, "_shrink_skip_noted", None)
@@ -1035,7 +1040,7 @@ class PHBase(SPBase):
         return self._chunk_idx_cache[(chunk, S)]
 
     def _ensure_chunk_states(self, key, factors, data, slices,
-                             chunks=None, lc=None):
+                             chunks=None, lc=None, cold_data=None):
         """Per-chunk QPStates (each owns its L / rho_scale trajectory —
         cross-chunk sharing would let one chunk's rho adaptation corrupt
         another's warm start). Authoritative store for chunked mode;
@@ -1061,7 +1066,12 @@ class PHBase(SPBase):
             # shapes are identical), and immutable buffers make the
             # sharing safe — at df32 scale each per-chunk factor copy
             # would cost ~0.7 GB x chunk count
-            if chunks is not None:
+            if cold_data is not None:
+                # streamed/synthesized source: the caller staged one
+                # chunk-shaped block (data itself is a 2-row setup
+                # surrogate with nothing to slice)
+                d0 = cold_data
+            elif chunks is not None:
                 d0 = data._replace(l=chunks["l"][0], u=chunks["u"][0],
                                    lb=chunks["lb"][0], ub=chunks["ub"][0])
             else:
@@ -1124,7 +1134,8 @@ class PHBase(SPBase):
                 for ci in range(n_chunks)]
         return self._chunk_idx_cache[key]
 
-    def _chunked_inputs(self, data, lc, shrink=None, c0fold=None):
+    def _chunked_inputs(self, data, lc, shrink=None, c0fold=None,
+                        stream=False):
         """Every per-scenario operand of one chunked sharded pass,
         restaged as (n_chunks, lc*n_dev, ...) sharded arrays in ONE
         jitted local reshape — no per-chunk device_put, no host
@@ -1137,6 +1148,17 @@ class PHBase(SPBase):
         (``cF``/``WF``) — pass 3 expands each chunk's solution before
         evaluating them, so objectives remain bit-comparable with the
         uncompacted wheel."""
+        if stream:
+            # streamed/synthesized source: l/u/lb/ub/c arrive per
+            # chunk from the source (with the chunk-row sharding), and
+            # the shared P row broadcasts in the objective jit — only
+            # the RESIDENT small state restages here
+            per_scen = {"c0": self.c0, "W": self.W, "xbar": self.xbar,
+                        "rho": self.rho, "fm": self._fixed_mask,
+                        "fv": self._fixed_vals}
+            if self._w_scale is not None:
+                per_scen["ws"] = self._w_scale
+            return self._shard_ops.to_chunks(per_scen, lc)
         per_scen = {"l": data.l, "u": data.u, "lb": data.lb,
                     "ub": data.ub, "c0": self.c0, "P0": self.P_diag}
         if shrink is None:
@@ -1214,13 +1236,15 @@ class PHBase(SPBase):
         idx_asm = shrink.idx_c if shrink is not None else self.nonant_idx
         c0fold = None if shrink is None else self._shrink_dual_fold(
             shrink, w_on, prox_on)
+        stream = self._stream_source
         ops = self._shard_ops
         sharded = ops is not None
         if sharded:
             lc = self._local_chunk(chunk)
             slices = self._sharded_chunk_slices(lc)
             chs = self._chunked_inputs(data, lc, shrink=shrink,
-                                       c0fold=c0fold)
+                                       c0fold=c0fold,
+                                       stream=stream is not None)
         else:
             lc, chs = None, None
             slices = self._chunk_index(chunk)
@@ -1237,10 +1261,32 @@ class PHBase(SPBase):
                                            self.rho)
                 a_fm, a_fv = self._fixed_mask, self._fixed_vals
                 a_ws = self._w_scale
+        cold_d = None
+        if stream is not None:
+            # bind the source to THIS layout: chunk ci's global
+            # scenario rows in chunk-row order — exactly the gate/
+            # hospital slice maps. The id conversion is gated on an
+            # actual layout change (once per (chunk, S), never
+            # steady-state — the per-call spelling would be a small
+            # D2H per iteration).
+            lkey = (("sharded", lc, self.batch.S) if sharded
+                    else ("host", chunk, self.batch.S))
+            if stream.bound_key != lkey:
+                # lint: ok[SYNC001] layout staging once per chunk-layout change (guarded by bound_key above), never per iteration
+                stream.bind(lkey, [np.asarray(idx) for idx, _ in slices])
         self._drop_if_dirty(key)
+        if stream is not None \
+                and ("chunks", key) not in self._qp_states:
+            # cold chunk states need one chunk-shaped data block; a
+            # direct fetch outside the pipeline's in-order pass (once
+            # per mode rebuild, never steady-state)
+            b0 = stream.fetch(0)
+            cold_d = data._replace(l=b0["l"], u=b0["u"],
+                                   lb=b0["lb"], ub=b0["ub"])
         fresh_states = ("chunks", key) not in self._qp_states
         states = self._ensure_chunk_states(key, factors, data, slices,
-                                           chunks=chs, lc=lc)
+                                           chunks=chs, lc=lc,
+                                           cold_data=cold_d)
         if fresh_states:
             # rebuilt chunk states share cold-state buffers — donation
             # must wait for the first completed pass to privatize them
@@ -1331,12 +1377,47 @@ class PHBase(SPBase):
                 w_on=bool(w_on), prox_on=bool(prox_on))
             return d_c._replace(lb=bl_c, ub=bu_c), q_c
 
+        def _stream_assemble(ci, direct=False):
+            """Streamed twin of _assemble: the five vector fields come
+            from the source (prefetched in-order; ``direct`` bypasses
+            the pipeline for the exceptional retry path), the resident
+            (S, K) state slices exactly as the resident path. Returns
+            (d_c, q_c, c_c) — the c chunk rides along because pass 3's
+            objectives need it and the records deliberately do NOT
+            keep data blocks alive across the iteration."""
+            blk = stream.fetch(ci) if direct else stream.chunk(ci)
+            d_c = data._replace(l=blk["l"], u=blk["u"],
+                                lb=blk["lb"], ub=blk["ub"])
+            if sharded:
+                W_c, xb_c, rho_c = (chs["W"][ci], chs["xbar"][ci],
+                                    chs["rho"][ci])
+                fm_c, fv_c = chs["fm"][ci], chs["fv"][ci]
+                ws = chs["ws"][ci] if "ws" in chs else None
+            else:
+                idx_c, _ = slices[ci]
+                W_c, xb_c, rho_c = (a_W[idx_c], a_xbar[idx_c],
+                                    a_rho[idx_c])
+                fm_c, fv_c = a_fm[idx_c], a_fv[idx_c]
+                ws = None if a_ws is None else a_ws[idx_c]
+            q_c, bl_c, bu_c = _ph_assemble(
+                d_c, blk["c"], W_c, xb_c, rho_c, idx_asm, fm_c, fv_c,
+                ws, w_on=bool(w_on), prox_on=bool(prox_on))
+            return d_c._replace(lb=bl_c, ub=bu_c), q_c, blk["c"]
+
         # ASSEMBLE — pipelined: enqueue every chunk's assembly now
         # (async dispatch); the device interleaves this elementwise work
         # with/ahead of the first solves and the host never again stops
-        # to assemble between chunks
-        inputs = [_assemble(ci) for ci in range(len(slices))] \
-            if pipeline else None
+        # to assemble between chunks. Streamed sources rewind their
+        # prefetch pipeline first (the SOLVE pass) and their assembly
+        # stays in the solve loop below — the double buffer bounds how
+        # many staged chunks exist, so enqueueing all of them up front
+        # would defeat the residency bound streaming exists for.
+        if stream is not None:
+            stream.begin_pass()
+            inputs = None
+        else:
+            inputs = [_assemble(ci) for ci in range(len(slices))] \
+                if pipeline else None
         _lap("assemble")
 
         # pass 1 — SOLVE. (Segmented solves sync on their own iteration
@@ -1346,7 +1427,17 @@ class PHBase(SPBase):
         solved_chunks = [None] * len(slices)
         prev_st = None
         for ci in range(len(slices)):
-            if pipeline:
+            if stream is not None:
+                # streamed staging: the prefetch thread has chunk ci
+                # (or is shipping it) — assembly cost books under
+                # "assemble" exactly like the sequential opt-out so
+                # the phase anatomy stays honest
+                t_a = _time.perf_counter()
+                d_c, q_c, _ = _stream_assemble(ci)
+                dt_a = _time.perf_counter() - t_a
+                acc["assemble"] += dt_a
+                t_mark += dt_a
+            elif pipeline:
                 d_c, q_c = inputs[ci]
             else:
                 # sequential opt-out: assembly stays interleaved on
@@ -1391,7 +1482,17 @@ class PHBase(SPBase):
                 # every refactorized ~0.7 GB copy simultaneously
                 # (the unify below re-attaches the flowed factor)
                 st = st._replace(L=jnp.zeros((), jnp.float32))
-            solved_chunks[ci] = [st, x, yA, yB, d_c, q_c, factors]
+            # streamed mode drops the data/assembly blocks from the
+            # record the moment the solve is enqueued: keeping every
+            # chunk's (d_c, q_c) alive through the iteration would
+            # re-materialize a full-batch footprint — the exact
+            # residency streaming exists to bound. Passes 2/3 restage
+            # on demand (retries directly, objectives via a second
+            # in-order pipeline pass).
+            solved_chunks[ci] = [st, x, yA, yB,
+                                 None if stream is not None else d_c,
+                                 None if stream is not None else q_c,
+                                 factors]
         if plan.mode == "fused":
             # phase honesty: fused programs never block mid-solve (no
             # per-segment iteration readbacks), so without this the
@@ -1476,10 +1577,17 @@ class PHBase(SPBase):
             if (m <= thr) or (ci in no_retry and not is_nan):
                 continue
             fac_c = rec[6]
+            if stream is not None:
+                # the record deliberately dropped the data blocks —
+                # restage this chunk directly (exceptional path; the
+                # in-order pipeline is between passes)
+                d_r, q_r, _ = _stream_assemble(ci, direct=True)
+            else:
+                d_r, q_r = rec[4], rec[5]
             if is_nan:
                 # NaN blowup: the iterates themselves are poison — a
                 # rho reset would re-iterate NaNs; restart cold
-                st_r = qp_cold_state(fac_c, rec[4])
+                st_r = qp_cold_state(fac_c, d_r)
             else:
                 # plateaued far out: keep the iterates, reset the
                 # stepsize trajectory
@@ -1499,7 +1607,7 @@ class PHBase(SPBase):
             kw_r = dict(kw, precision="native", kernel=None,
                         sub_max_iter=max(kw["sub_max_iter"]
                                          + 4 * kw["tail_iter"], 1500))
-            st2, x2, yA2, yB2 = _solver_call(fac_c, rec[4], rec[5],
+            st2, x2, yA2, yB2 = _solver_call(fac_c, d_r, q_r,
                                              st_r, **kw_r)
             pri2 = np.asarray(st2.pri_rel)   # lint: ok[SYNC001] exceptional-path retry sync, booked as its own gate_sync
             gate_syncs += 1
@@ -1538,21 +1646,24 @@ class PHBase(SPBase):
         # capped and only ever runs on the few flagged scenarios.
         from ..ops.qp_solver import ScaledView
         if bool(self.options.get("subproblem_hospital", True)) \
-                and shrink is None \
                 and not isinstance(data.A, (SplitMatrix, ScaledView)):
-            # (compacted passes skip the hospital: it re-assembles from
-            # the FULL cost/W blocks against per-scenario factors — a
-            # compacted spelling is future work; stragglers rely on the
-            # chunk retries + blacklist re-admission, which run on the
-            # compacted system unchanged)
-            # the hospital builds per-scenario (cap, m, n) batched
+            # COMPACTED passes run the hospital too (the ROADMAP item 5
+            # remainder, landed here): under an active shrink plan
+            # ``data`` is already the compacted system and _hospitalize
+            # assembles the rescue against the COMPACTED operands
+            # (shrink.c_c, free-slot W/x̄/ρ, idx_c) — the treated rows
+            # scatter back into the compacted-width records pass 3
+            # expands. Chunk retries + blacklist re-admission above run
+            # on the compacted system unchanged, as before.
+            # The hospital builds per-scenario (cap, m, n) batched
             # factors — structurally impossible at the scale df32
             # exists for (one (n, n) f64 host inversion there costs
-            # minutes); stragglers rely on chunk retries + blacklist
-            # re-admission instead
+            # minutes); those configs rely on chunk retries + blacklist
+            # re-admission instead (the isinstance guard).
             treated = self._hospitalize(key, slices, solved_chunks, data,
                                         thr, bool(w_on), bool(prox_on),
-                                        kw, pri_host=pri_host)
+                                        kw, pri_host=pri_host,
+                                        stream=stream, shrink=shrink)
             gate_syncs += treated
         # standing-casualty observability (VERDICT r3 #6): rows STILL
         # above the gate after recovery + hospital enter x̄/W with their
@@ -1586,7 +1697,16 @@ class PHBase(SPBase):
         ent["gate_syncs"] += gate_syncs
         obs.counter_add("ph.gate_syncs", gate_syncs)
         _lap("gate")
-        # pass 3 — per-chunk objectives on the accepted solutions
+        # pass 3 — per-chunk objectives on the accepted solutions.
+        # Streamed sources restage each chunk through a SECOND in-order
+        # pipeline pass (the records dropped the data blocks — see the
+        # pass-1 comment): the reassembled (d, q) are bit-identical to
+        # pass 1's (W/x̄/ρ/fixed masks only move after this pass), so
+        # the objectives and certified dual bound match the resident
+        # spelling exactly while per-iteration residency stays bounded
+        # by the pipeline depth.
+        if stream is not None:
+            stream.begin_pass()
         parts = {k: [] for k in ("x", "yA", "yB", "xn", "base", "solved",
                                  "dual")}
         for ci, (idx_c, real) in enumerate(slices):
@@ -1617,7 +1737,16 @@ class PHBase(SPBase):
                 dual = _shrink_dual(d_h, q_h, c0f_c, yA, yB,
                                     solved_chunks[ci][1])
             else:
-                if sharded:
+                if stream is not None:
+                    d_h, q_h, c_c = _stream_assemble(ci)
+                    c0_c = chs["c0"][ci] if sharded else self.c0[idx_c]
+                    W_c = chs["W"][ci] if sharded else self.W[idx_c]
+                    # the RAW shared P row broadcasts per chunk (the
+                    # objective must not carry the prox rho that
+                    # _data_with_prox added to ``data``'s diagonal)
+                    P0_c = jnp.broadcast_to(self.qp_data.P_diag,
+                                            c_c.shape)
+                elif sharded:
                     c_c, c0_c, P0_c, W_c = (chs["c"][ci], chs["c0"][ci],
                                             chs["P0"][ci], chs["W"][ci])
                 else:
@@ -1778,6 +1907,15 @@ class PHBase(SPBase):
                             "kernel.fused_iters",
                             "kernel.l_inv_factorizations",
                             "kernel.bf16_fallbacks",
+                            # scenario streaming (mpisppy_tpu/stream):
+                            # chunks/bytes staged this iteration —
+                            # analyze's streaming section asserts the
+                            # steady-state flatness off these deltas
+                            "stream.chunks_shipped",
+                            "stream.bytes_shipped",
+                            "stream.synth_chunks",
+                            "stream.prefetch_stalls",
+                            "stream.direct_fetches",
                             # progressive shrinking (ops/shrink): newly
                             # fixed slots and bucket transitions THIS
                             # iteration — analyze's shrinking section
@@ -1819,6 +1957,11 @@ class PHBase(SPBase):
             # maybe_compact — analyze's shrinking section plots
             # fixed-fraction, bucket, and est-HBM against s/iter
             rec["shrink"] = dict(self._shrink_status)
+        if self._stream_source is not None:
+            # scenario-source anatomy (doc/streaming.md): cumulative
+            # staging totals as plain host ints — per-iteration deltas
+            # ride counter_deltas below
+            rec["stream"] = self._stream_source.status()
         now = self._phase_totals()
         rec["phase_seconds"] = {k: now[k] - phase_before.get(k, 0.0)
                                 for k in now}
@@ -1830,7 +1973,8 @@ class PHBase(SPBase):
         return rec
 
     def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,
-                     prox_on, kw, pri_host=None):
+                     prox_on, kw, pri_host=None, stream=None,
+                     shrink=None):
         """Per-scenario rescue solves for chunked-mode stragglers (see
         the pass-2b comment in _solve_loop_chunked). Selected scenarios
         are re-assembled and solved NON-shared (own Ruiz/cost scaling
@@ -1876,18 +2020,54 @@ class PHBase(SPBase):
         pad = cap - sel.size
         sel_p = np.concatenate([sel, np.full(pad, sel[0])]) if pad else sel
         k = sel_p.size
-        n = self.batch.n
+        # the compacted width under an active shrink plan (data IS the
+        # compacted system there — the ROADMAP item 5 remainder's
+        # compacted hospital spelling), the full width otherwise
+        n = int(data.lb.shape[-1])
         A_b = jnp.broadcast_to(data.A, (k,) + data.A.shape) \
             if data.A.ndim == 2 else data.A[sel_p]
         P_b = jnp.broadcast_to(data.P_diag, (k, n)) \
             if data.P_diag.ndim == 1 else data.P_diag[sel_p]
-        d_h = QPData(P_b, A_b, data.l[sel_p], data.u[sel_p],
-                     data.lb[sel_p], data.ub[sel_p])
-        ws = None if self._w_scale is None else self._w_scale[sel_p]
+        if stream is not None:
+            # streamed source: the engine never shipped full-width
+            # vectors — stage exactly the flagged rows (host gather or
+            # in-kernel synthesis; an exceptional-path transfer booked
+            # like every other stream fetch)
+            rb = stream.rows(sel_p)
+            d_h = QPData(P_b, A_b, rb["l"], rb["u"], rb["lb"], rb["ub"])
+            c_sel = rb["c"]
+        else:
+            d_h = QPData(P_b, A_b, data.l[sel_p], data.u[sel_p],
+                         data.lb[sel_p], data.ub[sel_p])
+            c_sel = None
+        if shrink is not None:
+            # compacted assembly: free-slot gathers of the hub state +
+            # the compacted cost block, pinned by the compacted nonant
+            # index — mirrors _solve_loop_chunked's compacted
+            # operands, so the rescue solves THE SAME system the chunk
+            # solves do and its rows scatter back width-consistent
+            fs = shrink.free_slots_dev
+            c_sel = shrink.c_c[sel_p]
+            W_s, xb_s, rho_s = (self.W[sel_p][:, fs],
+                                self.xbar[sel_p][:, fs],
+                                self.rho[sel_p][:, fs])
+            fm_s, fv_s = (self._fixed_mask[sel_p][:, fs],
+                          self._fixed_vals[sel_p][:, fs])
+            ws = None if self._w_scale is None \
+                else self._w_scale[sel_p][:, fs]
+            idx_h = shrink.idx_c
+        else:
+            if c_sel is None:
+                c_sel = self.c[sel_p]
+            W_s, xb_s, rho_s = (self.W[sel_p], self.xbar[sel_p],
+                                self.rho[sel_p])
+            fm_s, fv_s = (self._fixed_mask[sel_p],
+                          self._fixed_vals[sel_p])
+            ws = None if self._w_scale is None else self._w_scale[sel_p]
+            idx_h = self.nonant_idx
         q_h, bl_h, bu_h = _ph_assemble(
-            d_h, self.c[sel_p], self.W[sel_p], self.xbar[sel_p],
-            self.rho[sel_p], self.nonant_idx, self._fixed_mask[sel_p],
-            self._fixed_vals[sel_p], ws, w_on=w_on, prox_on=prox_on)
+            d_h, c_sel, W_s, xb_s, rho_s, idx_h, fm_s, fv_s, ws,
+            w_on=w_on, prox_on=prox_on)
         d_h = d_h._replace(lb=bl_h, ub=bu_h)
         fac_h = qp_setup(d_h, q_ref=q_h)
         st_h = qp_cold_state(fac_h, d_h)
@@ -2004,6 +2184,12 @@ class PHBase(SPBase):
         sh = self._shard_ops
         chunked = chunk > 0 and (chunk < sh.shard_size if sh is not None
                                  else chunk < self.batch.S)
+        if self._stream_source is not None and not chunked:
+            raise ValueError(
+                "scenario streaming serves the CHUNKED hot loop only: "
+                "subproblem_chunk must be positive and below the "
+                f"(per-device) scenario count (got chunk={chunk}, "
+                f"S={self.batch.S}) — see doc/streaming.md")
         if chunked:
             out = self._solve_loop_chunked(chunk, w_on, prox_on, update,
                                            fixed)
@@ -2335,6 +2521,12 @@ class PHBase(SPBase):
             rec_ints = np.asarray(self.batch.integer) & ~nonant_cols
             if rec_ints.any() and self.options.get("xhat_dive_integers",
                                                    True):
+                if self._stream_source is not None:
+                    raise RuntimeError(
+                        "recourse-integer dives read the full-width "
+                        "cost/bound blocks, which a streamed/"
+                        "synthesized scenario source never ships "
+                        "(doc/streaming.md v1 scope)")
                 factors, d0 = self._get_factors(False, fixed=True)
                 idx = self.nonant_idx
                 lb = d0.lb.at[:, idx].set(
@@ -2404,6 +2596,11 @@ class PHBase(SPBase):
         coupling rows and returns nothing feasible).
 
         Returns (cands (S, K), feasible (S,) bool)."""
+        if self._stream_source is not None:
+            raise RuntimeError(
+                "dive_nonant_candidates reads the full-width scenario blocks, which a "
+                "streamed/synthesized scenario source never ships "
+                "(doc/streaming.md v1 scope)")
         if feas_tol is None:
             # the df32 kernel's residual floor under heavily pinned
             # bounds sits near 1e-3 — a gate AT the floor rejects every
@@ -2507,6 +2704,11 @@ class PHBase(SPBase):
         passes. Falls back to that sequential path for the shapes the
         chunked solver cannot batch (per-scenario A) or that need the
         per-candidate recourse-integer dive."""
+        if self._stream_source is not None:
+            raise RuntimeError(
+                "evaluate_incumbent_pool reads the full-width scenario blocks, which a "
+                "streamed/synthesized scenario source never ships "
+                "(doc/streaming.md v1 scope)")
         if feas_tol is None:
             feas_tol = float(self.options.get("xhat_feas_tol", 1e-4))
         pool = jnp.asarray(pool, self.dtype)

@@ -222,12 +222,42 @@ class SPBase:
             # 242 _create_scenarios; the sum check there is an Allreduce)
             raise ValueError("scenario probabilities must sum to 1 "
                              "(ref. spbase.py:443 checks)")
-        self.c = ship_stacked(b.c, t)
+        # scenario-source selection (mpisppy_tpu/stream,
+        # doc/streaming.md): a non-resident source replaces the
+        # full-width device residency of the five per-scenario vector
+        # fields (l/u/lb/ub/c) with per-chunk staging — built below
+        # once shared structure is established; everything the source
+        # does NOT cover ships exactly as before
+        self._stream_source = None
+        stream_kind = str(self.options.get("scenario_source",
+                                           "resident"))
+        from ..utils.config import STREAM_SOURCES
+        if stream_kind not in STREAM_SOURCES:
+            raise ValueError(f"unknown scenario_source {stream_kind!r};"
+                             f" known: {STREAM_SOURCES}")
+        streaming = stream_kind != "resident"
+        if streaming and not int(self.options.get("subproblem_chunk",
+                                                  0) or 0):
+            raise ValueError(
+                "scenario_source='streamed'/'synthesized' requires "
+                "subproblem_chunk: the chunked hot loop is the "
+                "streaming consumer (doc/streaming.md)")
+        if not streaming:
+            self.c = ship_stacked(b.c, t)
+            self.c_stage = ship_stacked(b.c_stage, t)
+            self.P_diag = jnp.asarray(b.P_diag, t)
+        else:
+            # set after the source builds (shared-structure check
+            # first); P_diag/c_stage stay host-only — the chunk loop
+            # broadcasts the shared P row per chunk, and the stage-
+            # split cost consumers (EF/lshaped/cross-scenario) are
+            # outside the streaming v1 surface (loud None failures)
+            self.c = None
+            self.c_stage = None
+            self.P_diag = None
         self.c0 = jnp.asarray(b.c0, t)
-        self.c_stage = ship_stacked(b.c_stage, t)
         self.c0_stage = jnp.asarray(b.c0_stage, t)
         self.nonant_idx = jnp.asarray(b.nonant_idx)
-        self.P_diag = jnp.asarray(b.P_diag, t)
         # shared-structure detection: when every scenario carries the SAME
         # constraint matrix and quadratic (only c/l/u/lb/ub differ — true
         # for uc/sizes/sslp/hydro where randomness enters the rhs), store A
@@ -299,12 +329,35 @@ class SPBase:
             cached = lambda key, fn: fn()
             A_dev = jnp.asarray(A_np, t)
             P_dev = self.P_diag
-        self.qp_data: QPData = QPData(
-            P_dev, A_dev,
-            cached(("l", str(t)), lambda: ship_stacked(b.l, t)),
-            cached(("u", str(t)), lambda: ship_stacked(b.u, t)),
-            cached(("lb", str(t)), lambda: ship_stacked(b.lb, t)),
-            cached(("ub", str(t)), lambda: ship_stacked(b.ub, t)))
+        if streaming:
+            if not self.shared_structure:
+                raise ValueError(
+                    "scenario_source='streamed'/'synthesized' requires "
+                    "a shared-structure batch (one A/P across "
+                    "scenarios — the representation the chunked "
+                    "single-factor loop streams over; models with "
+                    "per-scenario matrices keep scenario_source="
+                    "'resident'. farmer's synth family shares A: "
+                    "stream.synth.synth_batch / doc/streaming.md)")
+            from ..stream.source import make_source
+            self._stream_source = make_source(b, self.options, t,
+                                              mesh=mesh)
+            # EXACT 2-row setup surrogates (stream/source.py module
+            # docstring): qp_setup consumes the full-width vectors
+            # only through all-scenario eq patterns + the cost-scale
+            # max, so factors come out bit-identical to the resident
+            # path's — without the (S, m)/(S, n) residency
+            l2, u2, lb2, ub2, c2 = \
+                self._stream_source.setup_arrays(t)
+            self.c = c2
+            self.qp_data = QPData(P_dev, A_dev, l2, u2, lb2, ub2)
+        else:
+            self.qp_data = QPData(
+                P_dev, A_dev,
+                cached(("l", str(t)), lambda: ship_stacked(b.l, t)),
+                cached(("u", str(t)), lambda: ship_stacked(b.u, t)),
+                cached(("lb", str(t)), lambda: ship_stacked(b.lb, t)),
+                cached(("ub", str(t)), lambda: ship_stacked(b.ub, t)))
         # per-stage membership matrices for nonant reductions
         self.memberships = [jnp.asarray(b.tree.membership(s + 1), t)
                             for s in range(b.tree.num_stages - 1)]
@@ -351,18 +404,37 @@ class SPBase:
             self.prob = shard(self.prob)
             if self.vprob is not None:
                 self.vprob = shard(self.vprob)
-            self.c = shard(self.c)
             self.c0 = shard(self.c0)
-            self.c_stage = shard(self.c_stage)
             self.c0_stage = shard(self.c0_stage)
-            self.P_diag = shard(self.P_diag)
-            # shared (unbatched) fields replicate; batched fields shard on
-            # the scenario axis
-            batched_ndim = dict(P_diag=2, A=3, l=2, u=2, lb=2, ub=2)
-            self.qp_data = QPData(**{
-                k: (shard(a) if a.ndim == batched_ndim[k] else repl(a))
-                for k, a in self.qp_data._asdict().items()})
+            if not streaming:
+                self.c = shard(self.c)
+                self.c_stage = shard(self.c_stage)
+                self.P_diag = shard(self.P_diag)
+                # shared (unbatched) fields replicate; batched fields
+                # shard on the scenario axis
+                batched_ndim = dict(P_diag=2, A=3, l=2, u=2, lb=2, ub=2)
+                self.qp_data = QPData(**{
+                    k: (shard(a) if a.ndim == batched_ndim[k]
+                        else repl(a))
+                    for k, a in self.qp_data._asdict().items()})
+            else:
+                # streamed engines carry 2-row setup SURROGATES, not
+                # per-scenario data — they replicate like every other
+                # shared operand (the real per-scenario blocks arrive
+                # per chunk with the chunk-row sharding, placed by the
+                # source itself)
+                self.c = repl(self.c)
+                self.qp_data = QPData(**{
+                    k: repl(a) for k, a in self.qp_data._asdict().items()})
             self.memberships = [shard(B) for B in self.memberships]
+
+    def close_stream(self):
+        """Shut the scenario source's prefetch machinery down
+        (idempotent; restartable — the next chunked pass re-binds).
+        Wired into hub finalize and the SIGTERM preemption path so a
+        streamed wheel never hangs on a blocked producer thread."""
+        if self._stream_source is not None:
+            self._stream_source.close()
 
     # ---- reductions (the reference's Allreduce family) ----
     def Eobjective(self, obj_per_scen):
@@ -371,6 +443,12 @@ class SPBase:
 
     def scenario_objectives(self, x):
         """Per-scenario objective values for a (S, n) solution block."""
+        if self._stream_source is not None:
+            raise RuntimeError(
+                "scenario_objectives needs the full-width cost block, "
+                "which a streamed/synthesized scenario source never "
+                "ships (doc/streaming.md v1 scope) — the chunked hot "
+                "loop's per-chunk objectives cover the PH surface")
         quad = 0.5 * jnp.sum(self.P_diag * x * x, axis=-1)
         return quad + jnp.sum(self.c * x, axis=-1) + self.c0
 

@@ -625,6 +625,12 @@ class Hub(SPCommunicator):
                    "checkpointing and terminating")
         if self.ckpt is not None:
             self.ckpt.maybe_capture(force=True, reason="preempt")
+        # NOTE: a streamed engine's prefetch thread is NOT closed here
+        # — the signal frame interrupts the hub loop mid-iteration and
+        # the in-flight chunk pass still consumes staged blocks; the
+        # orderly close happens in hub_finalize (which the preempted
+        # loop reaches on its next termination check), and the thread
+        # is a daemon besides, so even a rough exit cannot hang on it.
         self._write_live_snapshot(force=True)
         obs.flush(nonblocking=True)
         self.send_terminate()
@@ -738,6 +744,11 @@ class Hub(SPCommunicator):
         # status server releases its port
         self._write_live_snapshot(force=True)
         self.shutdown_live()
+        # streamed engines: stop the prefetch thread with the wheel
+        # (idempotent; a serve-leased engine re-binds on its next pass)
+        cs = getattr(self.opt, "close_stream", None)
+        if callable(cs):
+            cs()
         return self.BestOuterBound, self.BestInnerBound
 
     def shutdown_live(self):

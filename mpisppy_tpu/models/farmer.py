@@ -79,6 +79,42 @@ def scenario_creator(scenario_name, use_integer=False, crops_multiplier=1,
     return m
 
 
+def scenario_synth_spec(template, seed=0, use_integer=False,
+                        crops_multiplier=1, sense="min",
+                        feed_spread=0.1):
+    """The farmer-family randomness-in-rhs synth spec (stream/synth.py,
+    doc/streaming.md): yields are pinned at the template scenario's
+    (shared constraint matrix — the chunked/streamed representation
+    needs one A), and the scenario randomness moves to the cattle-feed
+    REQUIREMENT rhs instead: scenario s demands
+    ``CATTLE_FEED * (1 + feed_spread * (2u - 1))`` with
+    ``u ~ U[0,1)^crops`` drawn from ``fold_in(PRNGKey(seed), s)`` —
+    random second-stage demand, the classic farmer variant whose
+    randomness the rhs can carry. Zero-requirement crops (sugar beets)
+    keep a zero rhs exactly (the spread multiplies the base).
+
+    The generator is pure jax, so the synthesized source manufactures
+    the same values in-kernel that :func:`~mpisppy_tpu.stream.synth
+    .materialize` stacks for the resident/streamed twins — equivalence
+    by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..stream.synth import SynthField, SynthSpec
+
+    sl = template.con_slices["EnforceCattleFeedRequirement"]
+    base = jnp.asarray(np.tile(CATTLE_FEED, crops_multiplier))
+    spread = float(feed_spread)
+
+    def fn(key):
+        u = jax.random.uniform(key, base.shape)
+        return (base * (1.0 + spread * (2.0 * u - 1.0)),)
+
+    return SynthSpec(seed=int(seed),
+                     fields=(SynthField("l", sl.start, sl.stop),),
+                     fn=fn)
+
+
 def make_tree(num_scens, crops_multiplier=1):
     names = [f"scen{i}" for i in range(num_scens)]
     return two_stage_tree(names, nonant_names=["DevotedAcreage"])

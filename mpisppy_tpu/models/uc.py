@@ -375,6 +375,53 @@ def scenario_vector_patch(scenario_name, num_gens=10, num_hours=24,
             ("ub", "spill"): np.maximum(wind, 0.0)}
 
 
+def scenario_synth_spec(template, seed=0, num_gens=10, num_hours=24,
+                        relax_integrality=True, min_up_down=False,
+                        ramping=False, t0_state=False,
+                        startup_shutdown_ramps=False, quick_start=False):
+    """The UC-family synth spec (stream/synth.py, doc/streaming.md):
+    the same three wind touch points as ``scenario_vector_patch``
+    (balance rhs, reserve rhs, spill upper bound), but the wind trace
+    is a jax-expressible seeded random walk — same shape discipline as
+    ``wind_scenario`` (smooth ~15%-of-capacity walk, clipped to
+    [0, 40%]) with jax's threefry replacing the numpy RandomState the
+    device generator cannot reproduce. A synth-UC scenario is therefore
+    a DIFFERENT instance from the RandomState one at the same id —
+    deliberately: the spec is the single source of the family's data,
+    and resident/streamed/synthesized runs of the synth family are
+    identical by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..stream.synth import SynthField, SynthSpec
+
+    T = num_hours
+    load = jnp.asarray(load_profile(num_hours, num_gens))
+    cap = float(fleet(num_gens)["pmax"].sum())
+    qs_cap = float(fleet(num_gens)["pmax"][
+        quick_start_set(num_gens)].sum()) if quick_start else 0.0
+    inv_sqrt = 1.0 / jnp.sqrt(jnp.arange(1, T + 1, dtype=jnp.float64))
+
+    def fn(key):
+        steps = jax.random.normal(key, (T,)) * 0.25
+        wind = jnp.clip(0.15 + 0.1 * jnp.cumsum(steps) * inv_sqrt,
+                        0.0, 0.4) * cap
+        bal = load - wind
+        res = (1.0 + RESERVE_FRAC) * load - wind - qs_cap
+        return bal, bal, res, wind
+
+    bal = template.con_slices["balance"]
+    resv = template.con_slices["reserve"]
+    spill = template.var_slices["spill"]
+    return SynthSpec(
+        seed=int(seed),
+        fields=(SynthField("l", bal.start, bal.stop),
+                SynthField("u", bal.start, bal.stop),
+                SynthField("l", resv.start, resv.stop),
+                SynthField("ub", spill.start, spill.stop)),
+        fn=fn)
+
+
 def make_tree(num_scens):
     names = [f"scen{i}" for i in range(num_scens)]
     return two_stage_tree(names, nonant_names=["u", "st"])

@@ -409,6 +409,58 @@ def shrink_summary(run: Run) -> dict | None:
     }
 
 
+def streaming_summary(run: Run) -> dict | None:
+    """Scenario-streaming activity (mpisppy_tpu/stream,
+    doc/streaming.md): the source kind, bytes shipped vs chunks
+    synthesized, prefetch occupancy (how often the consumer outran the
+    double buffer), int8 gate fallbacks, and THE acceptance signal —
+    whether the per-iteration ``xfer.device_put_bytes`` deltas stayed
+    flat across steady-state iterations. None when no scenario source
+    ran."""
+    tot = {}
+    for role in run.metrics:
+        for k, v in run.counters(role).items():
+            if k.startswith("stream."):
+                tot[k] = tot.get(k, 0) + v
+    rows = [e for e in iteration_rows(run) if e.get("stream")]
+    if not tot and not rows:
+        return None
+    source = rows[-1]["stream"].get("source") if rows else None
+    chunks = int(tot.get("stream.chunks_shipped", 0))
+    synth = int(tot.get("stream.synth_chunks", 0))
+    stalls = int(tot.get("stream.prefetch_stalls", 0))
+    staged = chunks + synth
+    # per-iteration device_put deltas from the counter_deltas blocks:
+    # steady state starts at the SECOND recorded iteration (iteration 1
+    # builds the mode's cold chunk states — one direct fetch)
+    per_iter = [
+        {"iter": e["iter"],
+         "device_put_bytes":
+             e.get("counter_deltas", {}).get("xfer.device_put_bytes", 0),
+         "bytes_shipped":
+             e.get("counter_deltas", {}).get("stream.bytes_shipped", 0),
+         "synth_chunks":
+             e.get("counter_deltas", {}).get("stream.synth_chunks", 0)}
+        for e in iteration_rows(run)]
+    steady = [r["device_put_bytes"] for r in per_iter[1:]]
+    return {
+        "source": source,
+        "chunks_shipped": chunks,
+        "bytes_shipped": int(tot.get("stream.bytes_shipped", 0)),
+        "synth_chunks": synth,
+        "direct_fetches": int(tot.get("stream.direct_fetches", 0)),
+        "int8_fallbacks": int(tot.get("stream.int8_fallbacks", 0)),
+        "prefetch_stalls": stalls,
+        # fraction of staged chunks the prefetcher had ready before the
+        # consumer asked — 1.0 means the H2D fully hid under compute
+        "prefetch_occupancy":
+            (1.0 - stalls / staged) if staged else None,
+        "device_put_flat_steady_state":
+            (len(set(steady)) <= 1) if len(steady) >= 2 else None,
+        "per_iteration": per_iter,
+    }
+
+
 def checkpoint_summary(run: Run) -> dict | None:
     """Durable checkpoint activity (mpisppy_tpu.ckpt,
     doc/fault_tolerance.md): ``ckpt.*`` counters summed across roles
@@ -998,6 +1050,27 @@ def render_report(run: Run) -> str:
                                  for t in tr[-8:]))
         L.append("")
 
+    stm = streaming_summary(run)
+    if stm is not None:
+        L.append("== streaming ==")
+        occ = stm["prefetch_occupancy"]
+        L.append(f"source {stm['source'] or '?'}  chunks shipped "
+                 f"{stm['chunks_shipped']} ({_fmt_b(stm['bytes_shipped'])})"
+                 f"  synthesized {stm['synth_chunks']}  direct fetches "
+                 f"{stm['direct_fetches']}")
+        L.append(f"prefetch stalls {stm['prefetch_stalls']}"
+                 + (f"  occupancy {_fmt(occ, 3)}" if occ is not None
+                    else "")
+                 + f"  int8 fallbacks {stm['int8_fallbacks']}")
+        flat = stm["device_put_flat_steady_state"]
+        if flat is not None:
+            L.append("steady-state device_put: "
+                     + ("FLAT (the streaming acceptance contract)"
+                        if flat else
+                        "NOT FLAT — per-iteration transfer grew or "
+                        "leaked (see per_iteration in --json)"))
+        L.append("")
+
     inc = incumbent_summary(run)
     if inc is not None:
         L.append("== incumbent ==")
@@ -1020,7 +1093,7 @@ def render_report(run: Run) -> str:
     L.append("== counters ==")
     for k in sorted(c):
         if k.split(".")[0] in ("ph", "qp", "hub", "spoke", "incumbent",
-                               "serve", "shrink"):
+                               "serve", "shrink", "stream"):
             L.append(f"  {k} = {_fmt(c[k])}")
     L.append("")
 
@@ -1134,6 +1207,14 @@ def comparison_metrics(run: Run) -> dict:
         # the dedicated verdict row in compare() handles those
         out[("kernel_fused_iters_per_solve_call", "count")] = \
             c["kernel.fused_iters"] / calls
+    if calls and "stream.bytes_shipped" in c:
+        # streamed runs (ISSUE 15, doc/streaming.md): shipped volume
+        # per solve call — a streamed-vs-streamed compare flags a
+        # staging regression (e.g. an int8 field regressing to f64, or
+        # a third restage pass sneaking into the iteration); absent on
+        # resident/synthesized runs, skipped by compare()
+        out[("stream_kbytes_per_solve_call", "count")] = \
+            c["stream.bytes_shipped"] / 1024.0 / calls
     return out
 
 
@@ -1213,6 +1294,28 @@ def compare(a: Run, b: Run, threshold=1.5,
             f"l_inv={kb['l_inv_factorizations']}, "
             f"bf16_fallbacks={kb['bf16_fallbacks']}) — "
             f"per-iteration verdict [{tag}]")
+    # streaming verdict row (ISSUE 15, doc/streaming.md): for a run
+    # with an active scenario source, the acceptance contract is FLAT
+    # steady-state device_put deltas — restate each side's flatness +
+    # staging anatomy as one explicit line; a side whose steady-state
+    # transfer grew books a regression.
+    for tag, run_ in (("A", a), ("B", b)):
+        sm = streaming_summary(run_)
+        if sm is None:
+            continue
+        flat = sm["device_put_flat_steady_state"]
+        verdict = "PASS"
+        if flat is False:
+            verdict = "REGRESSION"
+            regressions.append(f"stream_flat_device_put[{tag}]")
+        occ = sm["prefetch_occupancy"]
+        L.append(
+            f"  stream[{tag}]: source={sm['source'] or '?'} "
+            f"shipped={_fmt_b(sm['bytes_shipped'])} "
+            f"synth_chunks={sm['synth_chunks']} "
+            f"int8_fallbacks={sm['int8_fallbacks']}"
+            + (f" occupancy={_fmt(occ, 3)}" if occ is not None else "")
+            + f" — steady-state device_put verdict [{verdict}]")
     # per-iteration-time-vs-active-set verdict row (ISSUE 14,
     # doc/extensions.md §shrinking): for a run with compactions, the
     # shrinking promise is that post-compaction iterations get
@@ -1446,6 +1549,8 @@ def main(argv=None) -> int:
                                 "b": kernel_summary(b)},
                      "shrink": {"a": shrink_summary(a),
                                 "b": shrink_summary(b)},
+                     "streaming": {"a": streaming_summary(a),
+                                   "b": streaming_summary(b)},
                      "verdict": "PASS" if passed else "REGRESSION"}))
             else:
                 print(text)
@@ -1466,6 +1571,7 @@ def main(argv=None) -> int:
                             if k != "entries"},
                 "sharding": sharding_summary(run),
                 "shrink": shrink_summary(run),
+                "streaming": streaming_summary(run),
                 "incumbent": incumbent_summary(run),
                 "checkpoint": checkpoint_summary(run),
                 "serving": serving_summary(run),

@@ -84,16 +84,40 @@ def install_batch(engine, stacked):
     engine.batch = b
     engine._S_orig = b.S
     engine.prob = jnp.asarray(b.prob, t)
-    engine.c = ship_stacked(b.c, t)
-    engine.c0 = jnp.asarray(b.c0, t)
-    engine.c_stage = ship_stacked(b.c_stage, t)
-    engine.c0_stage = jnp.asarray(b.c0_stage, t)
-    # structure (P_diag, A) is bucket-shared — only the bound/rhs
-    # vectors re-ship; the factorizations built from (A, P, rho)
-    # stay valid and warm
-    engine.qp_data = engine.qp_data._replace(
-        l=ship_stacked(b.l, t), u=ship_stacked(b.u, t),
-        lb=ship_stacked(b.lb, t), ub=ship_stacked(b.ub, t))
+    src = getattr(engine, "_stream_source", None)
+    if src is None:
+        engine.c = ship_stacked(b.c, t)
+        engine.c0 = jnp.asarray(b.c0, t)
+        engine.c_stage = ship_stacked(b.c_stage, t)
+        engine.c0_stage = jnp.asarray(b.c0_stage, t)
+        # structure (P_diag, A) is bucket-shared — only the bound/rhs
+        # vectors re-ship; the factorizations built from (A, P, rho)
+        # stay valid and warm
+        engine.qp_data = engine.qp_data._replace(
+            l=ship_stacked(b.l, t), u=ship_stacked(b.u, t),
+            lb=ship_stacked(b.lb, t), ub=ship_stacked(b.ub, t))
+    else:
+        # streamed/synthesized scenario source (mpisppy_tpu/stream):
+        # the engine's qp_data carries setup SURROGATES, not data —
+        # the tenant swap installs the new vectors into the HOST store
+        # (streamed; tears down the previous tenant's pipeline and
+        # staged buffers) and refreshes the surrogates so the factor
+        # snapshots below see the new tenant's eq patterns/cost scale.
+        # Synthesized engines have no vectors to install — their data
+        # IS bucket identity (model + model_kwargs derive the spec) —
+        # so the swap only resets staging. Bucket fingerprints include
+        # scenario_source/stream_int8 (AlgoConfig.to_options), so a
+        # resident request can never lease this engine.
+        engine.c0 = jnp.asarray(b.c0, t)
+        engine.c0_stage = jnp.asarray(b.c0_stage, t)
+        if src.kind == "streamed":
+            src.install(b)
+        else:
+            src.close()
+        l2, u2, lb2, ub2, c2 = src.setup_arrays(t)
+        engine.c = c2
+        engine.qp_data = engine.qp_data._replace(l=l2, u=u2, lb=lb2,
+                                                 ub=ub2)
     S, K = b.S, b.K
     engine.rho = jnp.asarray(
         np.broadcast_to(np.full(K, engine.rho_default), (S, K)), t)

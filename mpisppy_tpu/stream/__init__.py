@@ -1,0 +1,26 @@
+"""Scenario streaming engine — the S=100k–1M scale wall (ROADMAP item 3).
+
+The chunked hot loop's per-scenario vector blocks stop being
+HBM-resident: a :class:`~mpisppy_tpu.stream.source.ScenarioSource`
+(``scenario_source`` engine option: ``resident`` | ``streamed`` |
+``synthesized``) stages them per chunk instead —
+
+- **streamed**: host store (optionally int8 delta-packed,
+  :mod:`.quant`) + a double-buffered prefetch thread
+  (:mod:`.pipeline`) overlapping chunk k+1's H2D under chunk k's
+  solve;
+- **synthesized**: a seeded jitted generator (:mod:`.synth`)
+  manufactures rhs/bound perturbations in-kernel from
+  ``(seed, scenario_id)`` — nothing ships at all.
+
+Anatomy, source selection, the quantization gate, and the
+observability catalog live in doc/streaming.md.
+"""
+
+from .pipeline import ChunkPipeline                      # noqa: F401
+from .quant import Int8Field, dequantize, quantize_field  # noqa: F401
+from .source import (ScenarioSource, StreamedSource,      # noqa: F401
+                     SynthesizedSource, make_source)
+from .synth import (SOURCE_FIELDS, SYNTH_FIELDS,          # noqa: F401
+                    SynthField, SynthSpec, materialize, synth_batch,
+                    synth_values)

@@ -1,0 +1,154 @@
+"""The double-buffered chunk prefetch pipeline.
+
+One daemon host thread walks the chunk sequence ahead of the solve
+loop, staging each chunk's device blocks into a bounded queue: while
+the device solves chunk k, the thread is already pushing chunk k+1's
+H2D transfer — the doc/kernels.md "overlap H2D of chunk k+1 under
+chunk k's solve" item. The queue bound (``depth``, default 2) IS the
+double buffer: the producer blocks once ``depth`` chunks are staged,
+so device-side staging residency never exceeds ``depth`` chunk blocks
+regardless of S.
+
+The loop consumes chunks strictly in order (``get(ci)``), possibly
+several passes per iteration (the solve pass and the objective pass of
+core/ph's streamed chunk loop); ``start_pass()`` rewinds the producer
+to chunk 0 and discards any stale staged blocks from a superseded
+pass.
+
+Shutdown: ``close()`` is idempotent and joins the thread; the thread
+is a daemon besides, so a SIGTERM/preemption exit can never hang on a
+blocked producer (Hub.handle_preemption closes the source explicitly —
+tests/test_stream.py pins the thread's exit).
+
+Accounting (all catalogued in doc/observability.md): the loader books
+``xfer.device_put_bytes`` / ``stream.bytes_shipped`` /
+``stream.chunks_shipped`` per staged chunk; this class books
+``stream.prefetch_stalls`` + the ``stream.prefetch_stall_seconds``
+histogram whenever the consumer outran the producer (the prefetch
+occupancy signal analyze's streaming section renders).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+
+from .. import obs
+
+
+class ChunkPipeline:
+    """``loader(ci) -> block`` run ``depth`` chunks ahead on a host
+    thread. The loader owns the device_put and its byte accounting;
+    the pipeline owns ordering, backpressure, and stall accounting."""
+
+    def __init__(self, loader, n_chunks: int, depth: int = 2,
+                 name: str = "stream-prefetch"):
+        if depth < 1:
+            raise ValueError("pipeline depth must be >= 1")
+        self.loader = loader
+        self.n_chunks = int(n_chunks)
+        self.depth = int(depth)
+        self._q = queue.Queue(maxsize=self.depth)
+        self._wake = threading.Event()
+        self._stop = False
+        self._gen = 0            # pass generation; bumped by start_pass
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._started = False
+
+    # ---- producer ----
+    def _run(self):
+        while True:
+            self._wake.wait()
+            if self._stop:
+                return
+            with self._lock:
+                gen = self._gen
+                self._wake.clear()
+            for ci in range(self.n_chunks):
+                if self._stop or self._gen != gen:
+                    break
+                try:
+                    blk = self.loader(ci)
+                except Exception as e:       # surfaced by get()
+                    self._q_put((gen, ci, None, e))
+                    break
+                if not self._q_put((gen, ci, blk, None)):
+                    break
+
+    def _q_put(self, item) -> bool:
+        """Bounded put that stays responsive to stop/rewind (a plain
+        blocking put could deadlock close() against a full queue)."""
+        gen = item[0]
+        while not self._stop and self._gen == gen:
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ---- consumer ----
+    def start_pass(self):
+        """Rewind to chunk 0 for a fresh in-order pass, discarding any
+        staged blocks of a superseded pass."""
+        with self._lock:
+            self._gen += 1
+        while True:                      # drain stale blocks
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        self._wake.set()
+
+    def get(self, ci: int):
+        """Chunk ``ci``'s staged block (strictly in-order consumption).
+        Books a prefetch stall when the producer hadn't staged it yet."""
+        t0 = None
+        while True:
+            try:
+                gen, got, blk, err = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if t0 is None:
+                    t0 = _time.perf_counter()
+                if self._stop or not self._thread.is_alive():
+                    raise RuntimeError(
+                        "stream prefetch thread is gone (closed or "
+                        "crashed) — no staged chunk to consume")
+                continue
+            if gen != self._gen:
+                continue                 # stale pass, drop
+            if err is not None:
+                raise err
+            if got != ci:
+                raise RuntimeError(
+                    f"stream pipeline out of order: wanted chunk {ci}, "
+                    f"staged {got} (chunks must be consumed in order; "
+                    "call start_pass() to rewind)")
+            if t0 is not None:
+                dt = _time.perf_counter() - t0
+                obs.counter_add("stream.prefetch_stalls")
+                obs.histogram_observe("stream.prefetch_stall_seconds", dt)
+            return blk
+
+    # ---- lifecycle ----
+    @property
+    def alive(self) -> bool:
+        return self._started and self._thread.is_alive()
+
+    def close(self):
+        """Idempotent shutdown: stop the producer, drain, join."""
+        self._stop = True
+        self._wake.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._started:
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,508 @@
+"""ScenarioSource: where a chunked engine's per-scenario vectors come from.
+
+The chunked hot loop (core/ph._solve_loop_chunked) consumes five
+per-scenario vector fields — ``l``/``u`` (S, m) and ``lb``/``ub``/``c``
+(S, n). The resident path ships all of them into HBM at engine build
+(core/spbase) and slices per chunk; that full-width residency is the
+S=100k–1M scale wall of ROADMAP item 3. A :class:`ScenarioSource`
+replaces the resident arrays with per-chunk staging:
+
+- :class:`StreamedSource` — the fields live on HOST (optionally int8
+  delta-packed, stream/quant.py); a :class:`~.pipeline.ChunkPipeline`
+  prefetch thread ships chunk k+1's blocks under chunk k's solve.
+  Device staging residency is bounded by the pipeline depth, host
+  residency by the (possibly packed) store.
+- :class:`SynthesizedSource` — nothing is stored OR shipped: a seeded
+  jitted generator (stream/synth.py) manufactures each chunk's
+  rhs/bound perturbations in-kernel from ``(seed, scenario_id)``;
+  chunk staging is pure device compute.
+
+Both expose the same surface to the engine:
+
+- ``setup_arrays(dtype)`` — EXACT 2-row surrogates of the full-width
+  setup reductions (see below), so qp_setup builds factors
+  bit-identical to the resident path's;
+- ``bind(layout)`` / ``begin_pass()`` / ``chunk(ci)`` — the in-order
+  chunk staging protocol (two passes per PH iteration: solve +
+  objectives);
+- ``fetch(ci)`` / ``rows(ids)`` — direct out-of-band staging for the
+  exceptional paths (cold-state build, chunk retries, the scenario
+  hospital);
+- ``status()`` — a plain host dict for bench's signal-safe gap-row
+  stamp; ``close()`` — idempotent pipeline shutdown (wired into
+  Hub.handle_preemption and engine finalize).
+
+The setup surrogate: for a SHARED-structure batch, qp_setup consumes
+the full-width vectors only through three exact reductions —
+``all_s(l==u)`` / ``all_s(lb==ub)`` row/column equality patterns and
+``max_{s,j} |D_j c_{s,j}|`` (the cost scale, ops/qp_solver
+._setup_vectors). A 2-row surrogate encoding those reductions
+(row pattern: (0, 0) where eq, (0, 1) where not; c rows: the
+per-column |c| max) therefore yields bit-identical factors — which is
+what makes streamed/synthesized trajectories EQUAL to resident ones
+rather than merely close (tests/test_stream.py pins it).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import obs
+from .pipeline import ChunkPipeline
+from .quant import dequantize, quantize_field
+from .synth import SOURCE_FIELDS, synth_values
+
+# is_eq tolerance must match ops/qp_solver._setup_vectors' predicate
+_EQ_TOL = 1e-9
+
+
+def _np_dtype(dtype):
+    """The engine dtype as a numpy dtype (host casts must round
+    exactly the way the device ship would)."""
+    return np.dtype(dtype)
+
+
+def _eq_pattern(l, u, dtype=None):
+    """The qp_setup equality predicate. ``dtype``: evaluate on values
+    CAST to the engine dtype first — the resident path computes the
+    pattern on the shipped (possibly f32) arrays, and a borderline
+    l/u pair that collapses to equality under f32 rounding must
+    classify identically here or the surrogate factors silently drift
+    from the resident ones."""
+    if dtype is not None:
+        t = _np_dtype(dtype)
+        l = np.asarray(l, t)
+        u = np.asarray(u, t)
+    d = u - l
+    return np.isfinite(d) & (np.abs(d) <= _EQ_TOL * (1.0 + np.abs(u)))
+
+
+def _surrogate_pair(eq: np.ndarray):
+    """(lo, hi) 2-row surrogates whose all-scenarios equality pattern
+    is exactly ``eq``: surrogate scenario 0 is (0, 0) everywhere (an
+    equality under the solver's relative tolerance), scenario 1 breaks
+    the non-eq columns with (0, 1) — so the per-column AND over the
+    two rows reproduces the true all-S pattern."""
+    lo = np.zeros((2,) + eq.shape)
+    hi = np.stack([np.zeros(eq.shape), np.where(eq, 0.0, 1.0)])
+    return lo, hi
+
+
+class ScenarioSource:
+    """Shared plumbing: chunk layout binding, device staging helpers,
+    status accounting. Subclasses implement ``_load(np_ids)`` (host
+    block for arbitrary scenario rows; streamed) or override
+    ``chunk``/``fetch``/``rows`` wholesale (synthesized)."""
+
+    kind = "abstract"
+    fields = SOURCE_FIELDS
+
+    def __init__(self, dtype, depth: int = 2, sharding=None):
+        self.dtype = dtype
+        self.depth = int(depth)
+        self.sharding = sharding     # ndim -> jax sharding, or None
+        self._layout_key = None
+        self._np_ids = None          # list[np.ndarray] per chunk
+        self._pipeline = None
+        self._status = {"source": self.kind, "chunks_shipped": 0,
+                        "bytes_shipped": 0, "synth_chunks": 0,
+                        "int8_fallbacks": 0, "direct_fetches": 0}
+
+    # ---- layout ----
+    @property
+    def bound_key(self):
+        """The currently bound chunk-layout key (None when unbound) —
+        callers gate their id staging on it so bind() cost is paid
+        once per layout change, never per iteration."""
+        return self._layout_key
+
+    def bind(self, key, np_ids):
+        """(Re)bind the chunk layout: ``np_ids[ci]`` are chunk ci's
+        global scenario rows in chunk-row order (tail chunks repeat
+        their last row; sharded chunks are device-major strided —
+        exactly core/ph's slice maps). A changed layout tears down the
+        pipeline; an unchanged one is a no-op."""
+        if key == self._layout_key:
+            return
+        self.close()
+        self._layout_key = key
+        self._np_ids = [np.asarray(ids) for ids in np_ids]
+        self._pipeline = self._make_pipeline()
+
+    def _make_pipeline(self):
+        return ChunkPipeline(self._stage_chunk, len(self._np_ids),
+                             depth=self.depth)
+
+    def begin_pass(self):
+        """Rewind staging to chunk 0 (called before the solve pass and
+        again before the objective pass of each PH iteration)."""
+        self._pipeline.start_pass()
+
+    def chunk(self, ci: int) -> dict:
+        """Chunk ci's staged device blocks (in-order, prefetched)."""
+        return self._pipeline.get(ci)
+
+    def fetch(self, ci: int) -> dict:
+        """Direct (pipeline-bypassing) staging of chunk ci — the
+        exceptional paths: cold-state build, chunk retries."""
+        self._status["direct_fetches"] += 1
+        obs.counter_add("stream.direct_fetches")
+        return self._stage_chunk(ci)
+
+    def rows(self, np_ids) -> dict:
+        """Device blocks for arbitrary scenario rows (the hospital's
+        per-scenario rescue assembly)."""
+        self._status["direct_fetches"] += 1
+        obs.counter_add("stream.direct_fetches")
+        return self._stage_rows(np.asarray(np_ids))
+
+    def _stage_chunk(self, ci: int) -> dict:
+        return self._stage_rows(self._np_ids[ci])
+
+    # ---- lifecycle / accounting ----
+    def status(self) -> dict:
+        """Plain host ints — signal-safe for bench's SIGTERM-flush
+        gap-row stamp."""
+        return dict(self._status)
+
+    def close(self):
+        if self._pipeline is not None:
+            self._pipeline.close()
+            self._pipeline = None
+        self._layout_key = None
+
+    @property
+    def prefetch_alive(self) -> bool:
+        return self._pipeline is not None and self._pipeline.alive
+
+    def _put(self, a_np, repl=False):
+        """Host block -> device, under the mesh chunk sharding when
+        present (``repl=True`` replicates instead — template rows are
+        shared operands, not chunk rows), with the placement bytes
+        booked (the streamed path's deliberate, flat-per-iteration
+        device_put)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.sharding is None:
+            out = jnp.asarray(a_np)
+        elif repl:
+            from jax.sharding import NamedSharding, PartitionSpec
+            mesh = self.sharding(1).mesh
+            out = jax.device_put(a_np, NamedSharding(
+                mesh, PartitionSpec(*([None] * np.ndim(a_np)))))
+        else:
+            out = jax.device_put(a_np, self.sharding(np.ndim(a_np)))
+        nb = int(np.asarray(a_np).nbytes)
+        self._status["bytes_shipped"] += nb
+        obs.counter_add("xfer.device_put_bytes", nb)
+        obs.counter_add("stream.bytes_shipped", nb)
+        return out
+
+
+class StreamedSource(ScenarioSource):
+    """Host-resident field store, double-buffered H2D chunk staging.
+    With ``stream_int8`` the store packs each field's per-scenario
+    deltas int8 behind the host-side gate (stream/quant.py): packed
+    fields ship int8 + per-block scale/zero and dequantize inside the
+    staging jit; gate-rejected fields keep f64 host storage and book
+    ``stream.int8_fallbacks``. Fields whose rows are all identical
+    (template-shared c of a rhs-randomness family) are detected at
+    build and never shipped at all — the template row lives on device
+    once and broadcasts per chunk."""
+
+    kind = "streamed"
+
+    def __init__(self, batch, dtype, depth=2, sharding=None,
+                 int8=False, int8_tol=1e-3):
+        super().__init__(dtype, depth=depth, sharding=sharding)
+        self._store = {}       # field -> ("const", tmpl) | ("f64", arr)
+        #                        | ("int8", Int8Field)
+        self._tmpl_dev = {}
+        self.install(batch, int8=int8, int8_tol=int8_tol)
+
+    def install(self, batch, int8=None, int8_tol=None):
+        """(Re)build the host store from a batch's stacked arrays —
+        engine construction and serve's install_batch tenant swap both
+        land here. Keeps the quantization policy unless overridden."""
+        if int8 is not None:
+            self._int8 = bool(int8)
+        if int8_tol is not None:
+            self._int8_tol = float(int8_tol)
+        self.close()           # a new tenant's data invalidates staging
+        self._store = {}
+        self._tmpl_dev = {}
+        for f in self.fields:
+            a = np.asarray(getattr(batch, f), np.float64)
+            tmpl = a[0]
+            if a.shape[0] > 1 and (a == tmpl[None, :]).all():
+                self._store[f] = ("const", tmpl.copy())
+                continue
+            if self._int8:
+                fld = quantize_field(a, tmpl, self._int8_tol)
+                if fld is not None:
+                    self._store[f] = ("int8", fld)
+                    continue
+                self._status["int8_fallbacks"] += 1
+                obs.counter_add("stream.int8_fallbacks")
+                obs.event("stream.int8_fallback", {"field": f})
+            self._store[f] = ("f64", a.copy())
+
+    def host_nbytes(self) -> int:
+        """Host residency of the store (the int8 win is visible here:
+        Int8Field.nbytes counts the packed representation)."""
+        return sum(val.nbytes for _, val in self._store.values())
+
+    def _stage_rows(self, ids) -> dict:
+        import jax.numpy as jnp
+
+        out = {}
+        rows = ids.shape[0]
+        for f in self.fields:
+            kind, val = self._store[f]
+            if kind == "const":
+                td = self._tmpl_dev.get(f)
+                if td is None:
+                    # pre-cast on host: ship engine-dtype bytes, not
+                    # f64 ones (one-time here; the per-chunk f64
+                    # branch below pays per iteration)
+                    td = self._tmpl_dev[f] = self._put(
+                        np.asarray(val, _np_dtype(self.dtype)),
+                        repl=True)
+                out[f] = jnp.broadcast_to(td[None, :], (rows,) + td.shape)
+            elif kind == "int8":
+                td = self._tmpl_dev.get(f)
+                if td is None:
+                    # template row + varying mask ship once, replicated
+                    td = self._tmpl_dev[f] = (
+                        self._put(np.asarray(val.tmpl, np.float64),
+                                  repl=True),
+                        self._put(val.varying, repl=True))
+                out[f] = dequantize(td[0], td[1], self._put(val.q[ids]),
+                                    self._put(val.scale[ids]),
+                                    self._put(val.zero[ids]), self.dtype)
+            else:
+                # cast HOST-side: an f32 engine must not pay f64 wire
+                # bytes per chunk per pass (the f64->f32 rounding is
+                # identical on host and device, so the values the
+                # solver sees — and the equality contract — are
+                # unchanged; the resident path's ship_stacked casts
+                # the same way)
+                out[f] = self._put(val[ids].astype(
+                    _np_dtype(self.dtype)))
+        self._status["chunks_shipped"] += 1
+        obs.counter_add("stream.chunks_shipped")
+        return out
+
+    def setup_arrays(self, dtype):
+        """Exact 2-row setup surrogates from one host pass over the
+        store (see the module docstring)."""
+        import jax.numpy as jnp
+
+        vals = {}
+        for f in self.fields:
+            kind, val = self._store[f]
+            if kind == "const":
+                vals[f] = val[None, :]
+            elif kind == "int8":
+                # reconstruct exactly what the device will see — the
+                # eq pattern must reflect QUANTIZED values
+                from .quant import _reconstruct_f32
+                vals[f] = _reconstruct_f32(val, slice(None))
+            else:
+                vals[f] = val
+        # patterns + the cost max evaluate on ENGINE-dtype values —
+        # exactly what the resident path's shipped arrays carry
+        eq_rows = _eq_pattern(vals["l"], vals["u"], dtype).all(axis=0)
+        eq_cols = _eq_pattern(vals["lb"], vals["ub"], dtype).all(axis=0)
+        c_max = np.abs(np.asarray(vals["c"],
+                                  _np_dtype(dtype))).max(axis=0)
+        l2, u2 = _surrogate_pair(eq_rows)
+        lb2, ub2 = _surrogate_pair(eq_cols)
+        c2 = np.broadcast_to(c_max, (2,) + c_max.shape)
+        return tuple(jnp.asarray(a, dtype)
+                     for a in (l2, u2, lb2, ub2, c2))
+
+
+class SynthesizedSource(ScenarioSource):
+    """Template rows on device + a seeded jitted generator: chunk
+    staging never ships scenario data (steady-state
+    ``xfer.device_put_bytes`` is ZERO — the flat-transfer half of the
+    sharding acceptance contract holds trivially)."""
+
+    kind = "synthesized"
+
+    def __init__(self, batch, spec, dtype, depth=2, sharding=None):
+        super().__init__(dtype, depth=depth, sharding=sharding)
+        self.spec = spec
+        self._S = int(batch.S)       # padded S — pad ids synthesize
+        #                              fresh p=0 scenarios, harmlessly
+        # template rows (batch vectors are broadcast views of them —
+        # synth.synth_batch(materialize_values=False))
+        self._tmpl = {f: np.asarray(getattr(batch, f), np.float64)[0]
+                      for f in self.fields}
+        self._tmpl_dev = None
+        self._asm = None
+        self._ids_dev = None
+
+    # synthesis is device compute — no prefetch thread, no H2D; the
+    # in-order pipeline protocol degenerates to calling the jit
+    def _make_pipeline(self):
+        return None
+
+    def begin_pass(self):
+        pass
+
+    def close(self):
+        self._layout_key = None
+        self._ids_dev = None
+
+    @property
+    def prefetch_alive(self) -> bool:
+        return False
+
+    def bind(self, key, np_ids):
+        if key == self._layout_key:
+            return
+        self._layout_key = key
+        self._np_ids = [np.asarray(ids) for ids in np_ids]
+        # per-chunk id vectors live on device once (a few KB total),
+        # sharded like chunk rows under a mesh — their placement is
+        # booked as the one deliberate device_put of a synth bind
+        self._ids_dev = [self._put(ids.astype(np.int32))
+                         for ids in self._np_ids]
+
+    def _assemble_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self._asm is not None:
+            return self._asm
+        if self._tmpl_dev is None:
+            # replicated shared operands (booked like any placement;
+            # once per source, never steady-state)
+            self._tmpl_dev = {f: self._put(v, repl=True)
+                              for f, v in self._tmpl.items()}
+        tmpl, spec, dtype = self._tmpl_dev, self.spec, self.dtype
+
+        def asm(ids):
+            rows = ids.shape[0]
+            out = {f: jnp.broadcast_to(
+                tmpl[f].astype(dtype)[None, :],
+                (rows,) + tmpl[f].shape) for f in SOURCE_FIELDS}
+            vals = synth_values(spec, ids)
+            for fld, v in zip(spec.fields, vals):
+                out[fld.field] = out[fld.field].at[
+                    :, fld.start:fld.stop].set(v.astype(dtype))
+            return out
+
+        self._asm = jax.jit(asm)
+        return self._asm
+
+    def chunk(self, ci: int) -> dict:
+        self._status["synth_chunks"] += 1
+        obs.counter_add("stream.synth_chunks")
+        return self._assemble_fn()(self._ids_dev[ci])
+
+    def fetch(self, ci: int) -> dict:
+        self._status["direct_fetches"] += 1
+        obs.counter_add("stream.direct_fetches")
+        return self.chunk(ci)
+
+    def rows(self, np_ids) -> dict:
+        self._status["direct_fetches"] += 1
+        obs.counter_add("stream.direct_fetches")
+        import jax.numpy as jnp
+        return self._assemble_fn()(jnp.asarray(np.asarray(np_ids),
+                                               jnp.int32))
+
+    def setup_arrays(self, dtype, batch_rows: int = 8192):
+        """Exact surrogates via ONE streaming host pass of the
+        generator: id batches are generated, their eq patterns folded
+        into the running all-scenarios AND, and the batch discarded —
+        S=1M costs host time, never host memory. Untouched fields keep
+        the template's own pattern (both rows equal the template, so
+        the pair's pattern IS the template pair's); c is untouched by
+        every synth spec (synth.SYNTH_FIELDS), so the cost-scale
+        surrogate is |template c| exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        tmpl = self._tmpl
+        eq_rows = _eq_pattern(tmpl["l"][None], tmpl["u"][None],
+                              dtype)[0]
+        eq_cols = _eq_pattern(tmpl["lb"][None], tmpl["ub"][None],
+                              dtype)[0]
+        touched = {f.field for f in self.spec.fields}
+        if touched:
+            # for a pair the spec touches, the TRUE all-scenario
+            # pattern is the generated scenarios' alone (the batch
+            # arrays below are template rows with the touched blocks
+            # replaced — untouched entries reproduce the template
+            # pair, so the reduction is correct over every column);
+            # the template's own pattern must be REPLACED, not ANDed:
+            # a spec pinning a row to equality the template left open
+            # would otherwise lose its eq boost
+            gen_rows = np.ones(tmpl["l"].shape, bool)
+            gen_cols = np.ones(tmpl["lb"].shape, bool)
+            fn = jax.jit(lambda ids: synth_values(self.spec, ids))
+            for lo in range(0, self._S, batch_rows):
+                ids = np.arange(lo, min(lo + batch_rows, self._S),
+                                dtype=np.int32)
+                vals = fn(ids)
+                blk = {f: np.broadcast_to(
+                    tmpl[f], (ids.size,) + tmpl[f].shape).copy()
+                    for f in touched}
+                for fld, v in zip(self.spec.fields, vals):
+                    blk[fld.field][:, fld.start:fld.stop] = \
+                        np.asarray(v, np.float64)
+                l_b = blk.get("l", tmpl["l"][None])
+                u_b = blk.get("u", tmpl["u"][None])
+                lb_b = blk.get("lb", tmpl["lb"][None])
+                ub_b = blk.get("ub", tmpl["ub"][None])
+                if touched & {"l", "u"}:
+                    gen_rows &= _eq_pattern(l_b, u_b,
+                                            dtype).all(axis=0)
+                if touched & {"lb", "ub"}:
+                    gen_cols &= _eq_pattern(lb_b, ub_b,
+                                            dtype).all(axis=0)
+            if touched & {"l", "u"}:
+                eq_rows = gen_rows
+            if touched & {"lb", "ub"}:
+                eq_cols = gen_cols
+        c_max = np.abs(np.asarray(tmpl["c"], _np_dtype(dtype)))
+        l2, u2 = _surrogate_pair(eq_rows)
+        lb2, ub2 = _surrogate_pair(eq_cols)
+        c2 = np.broadcast_to(c_max, (2,) + c_max.shape)
+        return tuple(jnp.asarray(a, dtype)
+                     for a in (l2, u2, lb2, ub2, c2))
+
+
+def make_source(batch, options: dict, dtype, mesh=None):
+    """Factory the engine build calls (core/spbase): resolves the
+    ``scenario_source`` option into a bound-ready source, or None for
+    the resident path."""
+    src = str(options.get("scenario_source", "resident"))
+    if src == "resident":
+        return None
+    sharding = None
+    if mesh is not None:
+        from ..parallel.mesh import scenario_sharding
+        sharding = lambda ndim: scenario_sharding(mesh, ndim)
+    depth = int(options.get("stream_depth", 2))
+    if src == "streamed":
+        return StreamedSource(
+            batch, dtype, depth=depth, sharding=sharding,
+            int8=bool(options.get("stream_int8", False)),
+            int8_tol=float(options.get("stream_int8_tol", 1e-3)))
+    if src == "synthesized":
+        spec = options.get("synth_spec")
+        if spec is None:
+            raise ValueError(
+                "scenario_source='synthesized' needs a synth_spec "
+                "engine option (models exporting scenario_synth_spec "
+                "get it via utils/vanilla; see doc/streaming.md)")
+        return SynthesizedSource(batch, spec, dtype, depth=depth,
+                                 sharding=sharding)
+    raise ValueError(f"unknown scenario_source {src!r}; known: "
+                     "('resident', 'streamed', 'synthesized')")

@@ -46,6 +46,14 @@ KNOWN_SPOKES = ("lagrangian", "lagranger", "xhatshuffle", "xhatlooper",
 # (jax-free) like the kernel constants: cylinder validation and the
 # CLI both read it.
 INCUMBENT_MODES = ("device", "oracle", "auto")
+# scenario-source selection for the chunked hot loop (mpisppy_tpu/
+# stream, doc/streaming.md): "resident" = full-width device arrays
+# (today's path), "streamed" = host store + double-buffered H2D chunk
+# pipeline, "synthesized" = device-side seeded generation for
+# randomness-in-rhs families. Defined HERE (jax-free) like the kernel
+# constants: engine validation, the CLI, and the serve payload
+# whitelist all read one tuple.
+STREAM_SOURCES = ("resident", "streamed", "synthesized")
 KNOWN_HUBS = ("ph", "aph", "lshaped")
 
 
@@ -109,6 +117,14 @@ class AlgoConfig:
     shrink_buckets: str = "0.25,0.5,0.75"   # fixed-fraction thresholds
     shrink_rho: bool = False        # per-slot device-side adaptive rho
     shrink_rho_interval: int = 1    # iterations between rho updates
+    # ---- scenario streaming (mpisppy_tpu/stream, doc/streaming.md):
+    # per-chunk staging of the per-scenario vector blocks instead of
+    # full-width HBM residency ----
+    scenario_source: str = "resident"   # STREAM_SOURCES
+    stream_int8: bool = False       # int8 delta-packed host storage
+    #                                 (explicit opt-in, host-side gate)
+    stream_int8_tol: float = 1e-3   # gate: max per-entry recon error
+    stream_depth: int = 2           # prefetch pipeline double-buffer
     linearize_proximal_terms: bool = False   # accepted + ignored (see ph.py)
     verbose: bool = False
 
@@ -138,6 +154,15 @@ class AlgoConfig:
             "shrink_buckets": self.shrink_buckets,
             "shrink_rho": self.shrink_rho,
             "shrink_rho_interval": self.shrink_rho_interval,
+            # stream knobs ride to_options() so they reach the engine
+            # AND the serve bucket fingerprint (a streamed engine's
+            # surrogate qp_data and host store must never be leased to
+            # a resident-source request, and int8-packed data is a
+            # different numerical contract than exact storage)
+            "scenario_source": self.scenario_source,
+            "stream_int8": self.stream_int8,
+            "stream_int8_tol": self.stream_int8_tol,
+            "stream_depth": self.stream_depth,
             "verbose": self.verbose,
         }
 
@@ -180,6 +205,25 @@ class AlgoConfig:
                              "compaction triggers on the device fixer's "
                              "fixed-fraction trajectory)")
         parse_shrink_buckets(self.shrink_buckets)
+        if self.scenario_source not in STREAM_SOURCES:
+            raise ValueError(
+                f"unknown scenario_source {self.scenario_source!r}; "
+                f"known: {STREAM_SOURCES}")
+        if self.stream_int8 and self.scenario_source != "streamed":
+            raise ValueError(
+                "stream_int8 packs the STREAMED host store — it needs "
+                "scenario_source='streamed' (synthesized sources ship "
+                "nothing; resident arrays are not packed)")
+        if self.stream_int8_tol <= 0:
+            raise ValueError("stream_int8_tol must be positive")
+        if self.stream_depth < 1:
+            raise ValueError("stream_depth must be >= 1")
+        if self.scenario_source != "resident" and self.shrink_compact:
+            raise ValueError(
+                "shrink_compact folds FULL-width data constants and "
+                "cannot run over a streamed/synthesized scenario "
+                "source (the device fixer alone — shrink_fix — "
+                "composes fine)")
         # the combined rule (ISSUE 7 small fix): an explicitly-fused
         # kernel unrolls the IR sweeps statically — out-of-band counts
         # must fail here with a clear error, not as a deep jit failure.

@@ -167,6 +167,23 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None,
     for t in threads:
         t.start()
 
+    # the preemption notice path (doc/fault_tolerance.md), in-process
+    # spelling: with checkpointing armed, SIGTERM forces one final
+    # bundle + clean terminate exactly like the process wheel
+    # (utils/multiproc) — a hub-only wheel (e.g. a streamed/synthesized
+    # engine, doc/streaming.md) is preemption-tolerant too, and the
+    # handler also stops a streamed source's prefetch thread through
+    # Hub.handle_preemption. Handler restored on every exit path.
+    prev_sigterm = None
+    if hub.ckpt is not None:
+        import signal as _signal
+
+        def _on_sigterm(signum, frame):
+            hub.handle_preemption("sigterm")
+        try:
+            prev_sigterm = _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            prev_sigterm = None         # not the main thread
     try:
         hub.main()                      # ref. sputils.py:115 spcomm.main()
     except BaseException:
@@ -176,6 +193,9 @@ def spin_the_wheel(hub_dict, list_of_spoke_dicts=(), spin_timeout=None,
         hub.shutdown_live()
         raise
     finally:
+        if prev_sigterm is not None:
+            import signal as _signal
+            _signal.signal(_signal.SIGTERM, prev_sigterm)
         hub.send_terminate()            # ref. sputils.py:117 / hub.py:356
     # two-phase join: spokes poll the kill signal between candidate
     # evaluations / oracle tasks, but one in-flight batched solve or

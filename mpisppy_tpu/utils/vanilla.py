@@ -55,6 +55,35 @@ def build_batch_for(cfg: RunConfig):
         kwargs.update(tk)
     else:
         tree = mod.make_tree(cfg.num_scens)
+    if cfg.algo.scenario_source == "synthesized":
+        # synthesized scenario source (mpisppy_tpu/stream,
+        # doc/streaming.md): the model's synth spec is the single
+        # source of the family's data — the creator runs once for the
+        # shared template, the batch vectors are zero-stride broadcast
+        # VIEWS of it (an S=1M batch costs no host memory), and the
+        # engine manufactures the per-scenario rhs perturbations
+        # in-kernel. The spec rides the batch to hub_dict, which
+        # forwards it as the ``synth_spec`` engine option.
+        if not hasattr(mod, "scenario_synth_spec"):
+            raise ValueError(
+                f"scenario_source='synthesized' needs model "
+                f"{cfg.model!r} to export scenario_synth_spec "
+                "(doc/streaming.md; farmer and uc do)")
+        if cfg.num_bundles:
+            raise ValueError("bundling merges scenario blocks and is "
+                             "not supported with a synthesized "
+                             "scenario source")
+        from ..stream.synth import synth_batch
+        seed = int(kwargs.pop("synth_seed", 0))
+        batch, spec = synth_batch(
+            mod.scenario_creator, tree, mod.scenario_synth_spec,
+            creator_kwargs=kwargs, seed=seed, materialize_values=False)
+        batch._synth_spec = spec
+        obs.event("batch.build", {"model": cfg.model, "S": batch.S,
+                                  "K": batch.K, "n": batch.n,
+                                  "shared_A": True,
+                                  "scenario_source": "synthesized"})
+        return batch
     batch = build_batch(mod.scenario_creator, tree, creator_kwargs=kwargs,
                         vector_patch=getattr(mod, "scenario_vector_patch",
                                              None))
@@ -133,6 +162,12 @@ def hub_dict(cfg: RunConfig, batch=None):
     opt_kwargs = {"batch": batch if batch is not None
                   else build_batch_for(cfg),
                   "options": options, **dtype_kw}
+    spec = getattr(opt_kwargs["batch"], "_synth_spec", None)
+    if spec is not None:
+        # the synthesized source's generator (build_batch_for attached
+        # it): an engine option rather than config — SynthSpec holds a
+        # jax callable and cannot ride the jax-free config tree
+        options["synth_spec"] = spec
     if cfg.mesh_devices is not None:
         if cfg.hub in ("ph", "aph") and not cross:
             # scenario-axis sharding for the hub engine
@@ -236,6 +271,15 @@ def wheel_dicts(cfg: RunConfig):
     template lowering costs ~a minute, so per-cylinder rebuilds would
     multiply a fixed cost by the wheel width."""
     cfg.validate()
+    if cfg.algo.scenario_source != "resident" and cfg.spokes:
+        # v1 scope (doc/streaming.md): spoke engines read full-width
+        # batch arrays (incumbent pools, Lagrangian warm states) that
+        # a streamed hub deliberately never ships — a streaming wheel
+        # runs hub-only until the spoke surfaces are stream-audited
+        raise ValueError(
+            "scenario_source='streamed'/'synthesized' wheels are "
+            "hub-only (doc/streaming.md v1 scope); drop the spokes or "
+            "use scenario_source='resident'")
     obs.event("wheel.build", {"model": cfg.model,
                               "num_scens": cfg.num_scens,
                               "hub": cfg.hub,

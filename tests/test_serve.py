@@ -805,3 +805,27 @@ def test_serve_e2e_compile_once_batching_and_sigterm_resume(tmp_path):
     assert sv["preempted_requests"] >= 1 and sv["service_preempted"]
     sv2 = serving_summary(load_run(tdir2))
     assert sv2 is not None and sv2["resumed"] >= 1
+
+
+def test_serve_loadbench_row_shaping():
+    """tools/serve_loadbench (ISSUE 15 satellite, the ROADMAP item 2
+    load-bench remainder): jax-free unit of the sizing logic — the
+    recommendation picks the best all-done throughput point and
+    refuses to recommend from failing points."""
+    from tools.serve_loadbench import recommend
+
+    rows = [
+        {"metric": "serve_load", "max_wheels": 1, "batch_max": 1,
+         "requests": 8, "done": 8, "failed": 0, "elapsed_s": 10.0,
+         "requests_per_s": 0.8},
+        {"metric": "serve_load", "max_wheels": 2, "batch_max": 8,
+         "requests": 8, "done": 8, "failed": 0, "elapsed_s": 4.0,
+         "requests_per_s": 2.0},
+        {"metric": "serve_load", "max_wheels": 4, "batch_max": 8,
+         "requests": 8, "done": 5, "failed": 3, "elapsed_s": 1.0,
+         "requests_per_s": 5.0},   # fastest but dropped requests
+    ]
+    rec = recommend(rows)
+    assert rec["metric"] == "serve_load_recommendation"
+    assert rec["recommended"] == {"max_wheels": 2, "batch_max": 8}
+    assert recommend([rows[2]])["recommended"] is None

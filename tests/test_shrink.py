@@ -483,3 +483,58 @@ def test_analyze_shrinking_section(tmp_path):
     report = render_report(run)
     assert "== shrinking ==" in report
     assert "per-bucket s/iter" in report
+
+
+def test_compacted_hospital_treats_flagged_rows(telemetry):
+    """ISSUE 15 satellite (the ROADMAP item 5 remainder): the
+    per-scenario hospital runs AGAINST THE COMPACTED SYSTEM instead of
+    bypassing compacted passes — the rescue assembles from the
+    compacted cost block + free-slot hub state, factors at the
+    compacted width, and scatters cured rows back into the
+    compacted-width records; chunk retries + blacklist re-admission
+    keep running on the compacted system as before."""
+    import jax.numpy as jnp
+
+    rec, tmp = telemetry
+    o = dict(UC_OPTS, shrink_compact=True, shrink_buckets="0.01",
+             id_fix_list_fct=slot0_fix_list)
+    ph = PH(uc_batch(6, 3, 6), o)
+    ph.ph_main()
+    shrink = ph._shrink
+    assert shrink is not None, "compaction never engaged"
+    factors, data = ph._get_factors(True)
+    # compacted width: the hospital must size its batched factors to
+    # THIS system, not the full one
+    assert data.lb.shape[-1] == shrink.n_c < ph.batch.n
+    slices = ph._chunk_index(3)
+    states = ph._qp_states[("chunks", True)]
+    nc, mc = shrink.n_c, data.l.shape[-1]
+    recs = []
+    for ci, (idx_c, real) in enumerate(slices):
+        st = states[ci]
+        if ci == 1:     # flag one row of chunk 1 as grossly unconverged
+            st = st._replace(pri_rel=st.pri_rel.at[0].set(1.0))
+        recs.append([st, jnp.zeros((3, nc)), jnp.zeros((3, mc)),
+                     jnp.zeros((3, nc)), None, None])
+    kw = dict(prox_on=True, precision=ph.sub_precision,
+              sub_max_iter=ph.sub_max_iter, sub_eps=ph.sub_eps,
+              sub_eps_hot=ph.sub_eps_hot,
+              sub_eps_dua_hot=ph.sub_eps_dua_hot,
+              tail_iter=ph.sub_tail_iter, stall_rel=ph.sub_stall_rel,
+              segment=ph.sub_segment, polish_hot=ph.sub_polish_hot,
+              polish_chunk=0, segment_lo=ph.sub_segment_lo)
+    treated0 = obs.counters_snapshot().get("ph.hospital_treated", 0)
+    ph._hospitalize(True, slices, recs, data, thr=1e-2, w_on=True,
+                    prox_on=True, kw=kw, shrink=shrink)
+    assert obs.counters_snapshot().get("ph.hospital_treated", 0) \
+        - treated0 == 1
+    # cured at the COMPACTED width and scattered back
+    assert float(recs[1][0].pri_rel[0]) < 1e-2
+    assert recs[1][1].shape == (3, nc)
+    assert float(jnp.abs(recs[1][1][0]).max()) > 0.0
+    # unflagged rows untouched
+    assert float(jnp.abs(recs[0][1]).max()) == 0.0
+    # and the full compacted loop keeps working with the hospital
+    # armed (it no longer bypasses): retries/blacklists path included
+    ph.solve_loop(w_on=True, prox_on=True)
+    assert np.asarray(ph.x).shape[1] == ph.batch.n

@@ -145,6 +145,11 @@ HOT_LOOP_DEFAULT = (
     "mpisppy_tpu/ops/incumbent.py",
     "mpisppy_tpu/ops/shrink.py",
     "mpisppy_tpu/parallel/mesh.py",
+    # the scenario streaming engine (doc/streaming.md): chunk staging
+    # runs INSIDE the chunked hot loop — a stray blocking readback in
+    # the source/pipeline serializes the chunk chain exactly like one
+    # in core/ph
+    "mpisppy_tpu/stream/",
 )
 
 # modules that document themselves jax-free (CHANGES/doc claims backed
@@ -255,6 +260,63 @@ SYNC_ALLOW_DEFAULT = {
             "compaction planning is host+eager once per BUCKET "
             "TRANSITION by documented contract (one fixed-mask read + "
             "one row-pattern read, never per iteration)",
+    },
+    # the scenario streaming engine (doc/streaming.md): these sites
+    # are HOST staging by design — the source's whole job is moving
+    # host-resident data toward the device (H2D, not the D2H readbacks
+    # SYNC001 hunts), and the setup/install passes run at engine
+    # build / tenant swap, never in the chunk chain
+    "mpisppy_tpu/stream/source.py": {
+        "_eq_pattern":
+            "pure host-numpy setup helper (the exact eq-pattern "
+            "surrogate math, engine-dtype cast included), consumed "
+            "only by the once-per-engine setup_arrays passes",
+        "ScenarioSource._put":
+            "the loader's deliberate H2D device_put — the transfer "
+            "streaming exists to make (books xfer.device_put_bytes); "
+            "host-side size reads only, no device readback",
+        "ScenarioSource.bind":
+            "layout staging once per chunk-layout change (callers "
+            "gate on bound_key), never per iteration",
+        "ScenarioSource.rows":
+            "exceptional-path row staging (hospital fetches): host id "
+            "conversion feeding the host-store gather",
+        "StreamedSource.install":
+            "host store build at engine construction / serve tenant "
+            "install — reads the HOST batch arrays, setup-time",
+        "StreamedSource._stage_rows":
+            "host gather of the host store feeding the H2D put — "
+            "host numpy indexing, no device readback",
+        "StreamedSource.setup_arrays":
+            "setup-time host reductions over the host store (the "
+            "exact eq-pattern/cost-scale surrogates), once per engine",
+        "SynthesizedSource.bind":
+            "per-chunk id vectors staged once per layout change",
+        "SynthesizedSource.rows":
+            "exceptional-path row staging (hospital fetches), host "
+            "id conversion only",
+        "SynthesizedSource.setup_arrays":
+            "setup-time streaming host pass of the generator (exact "
+            "surrogates), once per engine — the np.asarray reads the "
+            "generator's batch output, deliberately on host",
+    },
+    "mpisppy_tpu/stream/quant.py": {
+        "quantize_field":
+            "the int8 gate MUST run on host over the host store "
+            "(reproduces the device's f32 dequant arithmetic exactly); "
+            "build/install-time, never in the chunk chain",
+        "_reconstruct_f32":
+            "host twin of the device dequantization — pure numpy on "
+            "the host store (the gate's measurement basis)",
+    },
+    "mpisppy_tpu/stream/synth.py": {
+        "materialize":
+            "host materialization of the generator for resident/"
+            "streamed twins and setup stats — a build-time tool, "
+            "deliberately reading the jitted generator's output to "
+            "host",
+        "synth_batch":
+            "batch construction: host stacking at build time",
     },
 }
 

@@ -29,6 +29,13 @@ buckets iterate SLOWER than bucket 0 by more than the time threshold
 books a regression like any other compare row. The golden farmer
 bench runs shrink-free, so the row is absent there by construction.
 
+Since ISSUE 15 a streamed-farmer smoke rides after the compare stage
+(``--skip-stream-smoke`` opts out): a small SYNTHESIZED-source farmer
+wheel (``--scenario-source synthesized``, doc/streaming.md) whose
+telemetry must show stream activity AND flat steady-state
+``xfer.device_put_bytes`` — analyze's streaming section is the judge,
+so a staging leak or a source regression trips the gate in-repo.
+
 Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
 3 REGRESSION.
 
@@ -223,6 +230,45 @@ def run_serve_smoke(work_dir: str) -> int:
                 proc.kill()
 
 
+def run_stream_smoke(work_dir: str) -> int:
+    """The ISSUE 15 CI rider: the streaming acceptance contract,
+    gated. Runs a small synthesized-source farmer wheel (hub-only —
+    the v1 streaming scope) with telemetry on and asserts, through
+    analyze's streaming section, that (a) the scenario source actually
+    ran (synth chunks > 0) and (b) the per-iteration
+    ``xfer.device_put_bytes`` deltas stayed FLAT across steady-state
+    iterations — the doc/sharding.md transfer contract extended to
+    streamed wheels (doc/streaming.md)."""
+    tdir = os.path.join(work_dir, "stream_telemetry")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)
+    cmd = [sys.executable, "-m", "mpisppy_tpu", "farmer",
+           "--num-scens", "64", "--scenario-source", "synthesized",
+           "--subproblem-chunk", "16", "--max-iterations", "4",
+           "--convthresh", "-1", "--subproblem-max-iter", "1200",
+           "--telemetry-dir", tdir]
+    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=600)
+    if r.returncode != 0:
+        print(f"regression_gate: streamed farmer wheel failed "
+              f"(rc {r.returncode})")
+        return r.returncode or 1
+    from mpisppy_tpu.obs.analyze import load_run, streaming_summary
+    sm = streaming_summary(load_run(tdir))
+    if sm is None or not sm.get("synth_chunks"):
+        print("regression_gate: STREAM SMOKE FAILURE — the synthesized "
+              "source never staged a chunk (streaming section empty)")
+        return 3
+    if sm.get("device_put_flat_steady_state") is False:
+        print("regression_gate: STREAM SMOKE REGRESSION — steady-state "
+              "xfer.device_put_bytes deltas are not flat (per-iteration "
+              f"trajectory: {[r_['device_put_bytes'] for r_ in sm['per_iteration']]})")
+        return 3
+    print(f"regression_gate: stream smoke ok (synth chunks "
+          f"{sm['synth_chunks']}, steady-state device_put flat)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="tier-1 perf regression gate "
@@ -249,6 +295,10 @@ def main(argv=None) -> int:
     p.add_argument("--skip-serve-smoke", action="store_true",
                    help="skip the serving-layer compile-once smoke "
                         "stage (doc/serving.md); the bench + compare "
+                        "gate still runs")
+    p.add_argument("--skip-stream-smoke", action="store_true",
+                   help="skip the streamed-farmer flat-transfer smoke "
+                        "stage (doc/streaming.md); the bench + compare "
                         "gate still runs")
     args = p.parse_args(argv)
 
@@ -317,6 +367,12 @@ def main(argv=None) -> int:
                   "--update-golden and commit the new golden dir.")
         if rc != 0:
             return rc
+        if not args.skip_stream_smoke:
+            # stream smoke (ISSUE 15): the flat-transfer streaming
+            # contract on a synthesized farmer wheel
+            rc = run_stream_smoke(fresh)
+            if rc != 0:
+                return rc
         if args.skip_serve_smoke:
             return rc
         # serve smoke last (ISSUE 13): the compile-once contract on
