@@ -740,6 +740,89 @@ def bench_uc10_gap_device_bound():
              "feasibility)")
 
 
+def bench_aph_crossover():
+    """APH-vs-PH crossover sweep (ISSUE 16, doc/aph.md): dispatch_frac
+    × S on a synthesized farmer batch and a chunked UC instance, one
+    s/iter row and one time-to-gap row per (case, engine, frac). The
+    serving layer can later read these rows to pick the engine per
+    request: synchronous PH pays every scenario every iteration, APH
+    at dispatch_frac=f launches ~f·S solves — the crossover is where
+    f·S solves/iter × more iterations beats S solves/iter × fewer."""
+    from mpisppy_tpu.core.aph import APH
+    from mpisppy_tpu.core.ph import PH
+    from mpisppy_tpu.ir.batch import build_batch
+    from mpisppy_tpu.models import farmer, uc
+    from mpisppy_tpu.stream.synth import synth_batch
+
+    REL = 1e-3      # relative-gap target vs the PH reference objective
+    ITERS = 6
+    FRACS = (1.0, 0.5, 0.2)
+
+    def _cases():
+        for S in (512, 4096):
+            batch, spec = synth_batch(
+                farmer.scenario_creator, farmer.make_tree(S),
+                farmer.scenario_synth_spec, seed=0,
+                materialize_values=False)
+            yield (f"farmer_synth_S{S}", batch,
+                   {"defaultPHrho": 1.0, "scenario_source": "synthesized",
+                    "synth_spec": spec, "subproblem_chunk": 128,
+                    "subproblem_max_iter": 2000,
+                    "subproblem_eps": 1e-7}, S)
+        S = 64
+        batch = build_batch(
+            uc.scenario_creator, uc.make_tree(S),
+            creator_kwargs={"num_gens": 10, "num_hours": 12},
+            vector_patch=uc.scenario_vector_patch)
+        yield (f"uc_chunked_S{S}", batch,
+               {"defaultPHrho": 50.0, "subproblem_chunk": 16,
+                "subproblem_max_iter": 2000, "subproblem_eps": 1e-7}, S)
+
+    for label, batch, base_opts, S in _cases():
+        if _remaining() < 90:
+            _progress(f"SKIP crossover case {label}: "
+                      f"{_remaining():.0f}s left")
+            return
+        ref_obj = None
+        for engine, frac in [("ph", None)] + [("aph", f) for f in FRACS]:
+            opts = dict(base_opts, PHIterLimit=ITERS, convthresh=-1.0)
+            _progress(f"crossover {label}: {engine}"
+                      + (f" frac={frac:g}" if frac is not None else ""))
+            c0 = obs.counters_snapshot()
+            t0 = time.perf_counter()
+            if engine == "ph":
+                opt = PH(batch, opts, dtype=jax.numpy.float64)
+                _, obj, _ = opt.ph_main()
+            else:
+                opts["dispatch_frac"] = frac
+                opt = APH(batch, opts, dtype=jax.numpy.float64)
+                _, obj, _ = opt.APH_main()
+            dt = time.perf_counter() - t0
+            c1 = obs.counters_snapshot()
+            solved = c1.get("dispatch.solved_scenarios", 0) \
+                - c0.get("dispatch.solved_scenarios", 0)
+            if ref_obj is None:
+                ref_obj = obj     # PH runs first: the gap reference
+            gap = abs(obj - ref_obj) / max(1.0, abs(ref_obj))
+            row = {"case": label, "engine": engine, "S": S,
+                   "dispatch_frac": frac, "iters": ITERS,
+                   "rel_gap_vs_ph": round(gap, 6),
+                   "solved_per_iter":
+                       round(solved / max(ITERS, 1), 1) if solved else None}
+            emit(dict(row, metric="aph_crossover_s_per_iter",
+                      value=round(dt / (ITERS + 1), 4),
+                      unit="s/iter (wall incl. iter0; jit cache shared "
+                           "across the sweep so PH eats the compiles)"))
+            emit(dict(row, metric="aph_crossover_time_to_gap",
+                      value=round(dt, 3), reached_gap=bool(gap <= REL),
+                      unit=f"s wall to finish {ITERS} iters; reached_gap "
+                           f"= final objective within {REL:g} rel of the "
+                           "PH reference"))
+            del opt
+        if getattr(batch, "_dev_cache", None):
+            batch._dev_cache.clear()
+
+
 def bench_uc1024_gap():
     batch = big_batch(1024)
     # RE-SEQUENCED (r6): the outer bound no longer waits on the ~5-min
@@ -877,6 +960,7 @@ def main():
         (bench_uc10_gap, 0.0),              # the headline: always try
         (bench_uc10_gap_device_bound, 180.0),
         (lambda: (_release_device("uc10pad"), bench_throughput()), 150.0),
+        (bench_aph_crossover, 240.0),
         (bench_1024, 360.0),
         (bench_uc1024_gap, 420.0),
     ]

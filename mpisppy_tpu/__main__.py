@@ -117,6 +117,19 @@ def make_parser() -> argparse.ArgumentParser:
                         "call (the chunked hot loop; required by "
                         "non-resident --scenario-source). Lands in "
                         "hub_options like the programmatic spelling")
+    # APH φ-dispatch (--hub aph; core/aph.py + ops/dispatch.py,
+    # doc/aph.md)
+    p.add_argument("--dispatch-frac", type=float, default=1.0,
+                   help="APH: fraction of scenarios solved per "
+                        "iteration, most-negative-φ first with "
+                        "least-recently-dispatched fill (doc/aph.md); "
+                        "1.0 = full dispatch. Partial dispatch needs "
+                        "--hub aph")
+    p.add_argument("--aph-nu", type=float, default=1.0,
+                   help="APH projective step scale ν (θ = ν·φ/τ; ref. "
+                        "APHnu)")
+    p.add_argument("--aph-gamma", type=float, default=1.0,
+                   help="APH z-update damping γ (ref. APHgamma)")
     p.add_argument("--linearize-proximal-terms", action="store_true")
     p.add_argument("--verbose", action="store_true")
     # termination (ref. baseparsers.py:172 two_sided_args)
@@ -227,6 +240,9 @@ def config_from_args(args) -> RunConfig:
         stream_int8=args.stream_int8,
         stream_int8_tol=args.stream_int8_tol,
         stream_depth=args.stream_depth,
+        dispatch_frac=args.dispatch_frac,
+        aph_nu=args.aph_nu,
+        aph_gamma=args.aph_gamma,
         linearize_proximal_terms=args.linearize_proximal_terms,
         verbose=args.verbose,
     )

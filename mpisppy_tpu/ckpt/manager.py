@@ -43,11 +43,18 @@ def hub_state_arrays(opt) -> dict:
     the installed (W, x̄, ρ) before any spoke push, so stored nonants
     would be dead bytes in every bundle."""
     S = getattr(opt, "_S_orig", opt.batch.S)
-    return {"W": np.asarray(opt.W)[:S],
-            "xbar": np.asarray(opt.xbar)[:S],
-            "xsqbar": np.asarray(opt.xsqbar)[:S],
-            "rho": np.asarray(opt.rho)[:S],
-            "iter": np.asarray(int(getattr(opt, "_iter", 0)))}
+    arrays = {"W": np.asarray(opt.W)[:S],
+              "xbar": np.asarray(opt.xbar)[:S],
+              "xsqbar": np.asarray(opt.xsqbar)[:S],
+              "rho": np.asarray(opt.rho)[:S],
+              "iter": np.asarray(int(getattr(opt, "_iter", 0)))}
+    if hasattr(opt, "aph_state_arrays"):
+        # APH wheels bundle their projective + dispatch state too
+        # (``aph_``-prefixed extras — core/aph.py): without (z, y, x,
+        # phis, recency) a resumed APH wheel would re-dispatch from
+        # scratch and the trajectory would fork
+        arrays.update(opt.aph_state_arrays())
+    return arrays
 
 
 class CheckpointManager:
@@ -173,7 +180,14 @@ def resume_hub(hub, path, fingerprint=None):
     try:
         from ..extensions.wxbar_io import install_state_arrays
         install_state_arrays(opt, arrays)
-    except (CheckpointError, ValueError) as e:
+        if hasattr(opt, "install_aph_state") \
+                and "aph_z" in arrays:
+            # the APH extras travel as a set — a bundle either carries
+            # all of them (same capture) or none (pre-APH bundle /
+            # PH-hub bundle resumed into an APH wheel: projective
+            # state then cold-starts while (W, x̄, ρ) stay warm)
+            opt.install_aph_state(arrays)
+    except (CheckpointError, ValueError, KeyError) as e:
         _reject(getattr(e, "reason", "shape_mismatch"), str(e))
         return None
     opt._warm_started = True

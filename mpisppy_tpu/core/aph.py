@@ -41,11 +41,24 @@ TPU redesign:
   iteration's phis on host — is inherent to data-dependent dispatch,
   exactly as the reference blocks on its SecondReduce before
   dispatching (ref. aph.py:552-669).
-- Dispatch = a boolean mask over the scenario axis. The batch solves as one
-  SIMD program; non-dispatched scenarios' solutions are simply not accepted
-  (x, y keep their old values), costing nothing extra on the MXU.
+- Dispatch selection runs ON DEVICE (ops/dispatch.dispatch_select): the
+  negative-φ top-k and the least-recently-dispatched fill are one jitted
+  rank sort over the (S,) φ vector, and the whole iteration's host
+  traffic is ONE stacked D2H gate — [τ, φ, θ, conv, φ-stats] ++ mask —
+  booked as ``aph.gate_syncs`` (O(1) per iteration by counter test).
+- On the host-chunked hot loop, partial dispatch solves ONLY the
+  dispatched scenarios: solve_loop(dispatch=ids) microbatches the
+  dispatched id list into full-size chunks (ceil(scnt/chunk) device
+  calls instead of ceil(S/chunk)) and scatters results back, so
+  dispatch_frac=0.2 is a ~5x solve-FLOP cut, not a same-shape masked
+  launch (doc/aph.md). Fused (per-scenario A) and sharded engines keep
+  the masked-accept spelling: the batch solves as one SIMD program and
+  non-dispatched scenarios' solutions are simply not accepted.
 - The subproblem shares PH's cached prox-on KKT factorization: the prox
   center enters only the linear term q = c + scatter(W − ρz).
+- Active-set compaction (ops/shrink) composes: it compacts the VARIABLE
+  axis while dispatch selects on the SCENARIO axis, so φ scoring stays
+  full-width math while the dispatched solves run the compacted system.
 
 Options (reference names accepted): APHnu, APHgamma, dispatch_frac,
 aph_use_lag; async_frac_needed / async_sleep_secs are accepted and ignored
@@ -54,13 +67,15 @@ aph_use_lag; async_frac_needed / async_sleep_secs are accepted and ignored
 
 from __future__ import annotations
 
+import time as _time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import global_toc
+from .. import global_toc, obs
+from ..ops.dispatch import GATE_HEAD, dispatch_gate, scalar_gate
 from .ph import PHBase
 
 
@@ -119,11 +134,11 @@ class APH(PHBase):
 
     def __init__(self, batch, options=None, **kw):
         super().__init__(batch, options, **kw)
-        # active-set compaction (ops/shrink) is a synchronous-PH
-        # mechanic: APH's phi scoring / dispatch pools index the
-        # full-width solve state, so compaction stays off here (the
-        # device fixer's pin-boxes path still works)
-        self._shrink_allowed = False
+        # active-set compaction (ops/shrink) composes with dispatch:
+        # compaction packs the VARIABLE axis while φ/dispatch select on
+        # the SCENARIO axis, and φ stays full-width math regardless of
+        # the solve representation — so the PR 13 guard is lifted and
+        # _shrink_allowed keeps PHBase's default
         o = self.options
         self.nu = float(o.get("APHnu", 1.0))
         self.gamma = float(o.get("APHgamma", 1.0))
@@ -134,15 +149,26 @@ class APH(PHBase):
         self.z = jnp.zeros((S, K), t)
         self.y_aph = jnp.zeros((S, K), t)
         self.ybar = jnp.zeros((S, K), t)
+        # phis lives on DEVICE between iterations (dispatch selection
+        # reads it there); tests and APHShard may assign host arrays —
+        # every consumer goes through jnp/np.asarray
         self.phis = np.zeros(S)
         self._last_dispatch = np.zeros(S, np.int64)
         self._dispatched = np.ones(S, bool)   # iter 0 solves everyone
         self.theta = 0.0
         self.tau = self.phi = 0.0
+        self._phi_stats = None   # gate φ-histogram row (analyze/aph)
+        self._aph_status = None  # per-iteration record block (rec["aph"])
 
     # ---- dispatch selection (ref. aph.py:592-640 _dispatch_list) ----
     def _dispatch_mask(self, it, frac):
-        """Zero-probability mesh pad rows (core/spbase padding for
+        """HOST REFERENCE implementation of the dispatch selection —
+        the semantic contract ops/dispatch.dispatch_select reproduces
+        bit-for-bit on device (parity-tested in test_dispatch.py). The
+        hot loop reads the mask from the stacked gate; this spelling
+        serves APHShard's per-rank local pools and the tests.
+
+        Zero-probability mesh pad rows (core/spbase padding for
         uneven shards) are excluded from both the dispatch budget and
         the candidate pools: their phis are identically zero and the
         least-recently-dispatched fill would otherwise burn real
@@ -154,23 +180,41 @@ class APH(PHBase):
         if scnt >= S_real:
             mask[:S_real] = True
             return mask
+        # lint: ok[SYNC001] host reference path (APHShard/tests): the hot loop reads the mask from the packed gate instead
         phis = np.asarray(self.phis)[:S_real]
         neg = np.flatnonzero(phis < 0)
-        take = neg[np.argsort(phis[neg])][:scnt]
+        # stable sorts throughout: index order is the pinned tie-break
+        # (the device spelling's two-pass radix depends on it)
+        take = neg[np.argsort(phis[neg], kind="stable")][:scnt]
         mask[take] = True
         short = scnt - take.size
         if short > 0:
-            # least-recently-dispatched fill, phi as implicit tie-break
+            # least-recently-dispatched fill, index as the tie-break
             rest = np.flatnonzero(~mask[:S_real])
             oldest = rest[np.argsort(self._last_dispatch[rest],
                                      kind="stable")][:short]
             mask[oldest] = True
         return mask
 
+    def _dispatch_capable(self):
+        """True when partial dispatch can SKIP solves (the host-chunked
+        loop microbatches an arbitrary id list): shared-structure batch,
+        chunked, single device. Sharded and fused (per-scenario A)
+        engines keep masked acceptance — every scenario solves in the
+        one SIMD program and non-dispatched results are dropped."""
+        chunk = int(self.options.get("subproblem_chunk", 0))
+        return (self._shard_ops is None and 0 < chunk < self.batch.S
+                and getattr(self.qp_data.A, "ndim", 0) == 2)
+
     # ---- the solve with prox against z (ref. aph.py:866-883) ----
-    def _aph_solve(self, mask):
-        """Batched solve of min f_s + W·x + (ρ/2)‖x−z‖², accepting results
-        only for dispatched scenarios (the TPU carrier of asynchrony)."""
+    def _aph_solve(self, mask, didx=None):
+        """Batched solve of min f_s + W·x + (ρ/2)‖x−z‖² for the
+        dispatched scenarios (the TPU carrier of asynchrony). With
+        ``didx`` (host id array, ascending) the host-chunked loop
+        solves ONLY those scenarios and scatters their rows back —
+        undispatched state never enters a device call. Without it
+        (fused / sharded / full dispatch) every scenario solves and
+        non-dispatched results are simply not accepted."""
         W_solve = self._W_lag if self.use_lag else self.W
         z_solve = self._z_lag if self.use_lag else self.z
         saved_xbar, saved_W = self.xbar, self.W
@@ -178,14 +222,25 @@ class APH(PHBase):
         yA_old, yB_old = getattr(self, "yA", None), getattr(self, "yB", None)
         self.xbar, self.W = z_solve, W_solve   # prox center := z
         try:
-            self.solve_loop(w_on=True, prox_on=True, update=False)
+            self.solve_loop(w_on=True, prox_on=True, update=False,
+                            dispatch=didx)
         finally:
             self.xbar, self.W = saved_xbar, saved_W
         m = jnp.asarray(mask)[:, None]
-        self.x = jnp.where(m, self.x, x_old)
-        if yA_old is not None:
-            self.yA = jnp.where(m, self.yA, yA_old)
-            self.yB = jnp.where(m, self.yB, yB_old)
+        if didx is None:
+            # masked acceptance: all S solved, dispatched rows accepted
+            obs.counter_add("dispatch.solved_scenarios", self._S_orig)
+            self.x = jnp.where(m, self.x, x_old)
+            # dual merge only at matching widths: a compaction bucket
+            # transition changes the QP dual width mid-wheel (the
+            # transition pass dispatches everyone — APH_main), so the
+            # fresh duals stand whenever the old width died with it
+            if yA_old is not None and yA_old.shape == self.yA.shape \
+                    and yB_old.shape == self.yB.shape:
+                self.yA = jnp.where(m, self.yA, yA_old)
+                self.yB = jnp.where(m, self.yB, yB_old)
+        # else: the dispatch-masked chunked loop already scattered only
+        # the dispatched rows into x/yA/yB (and booked the counters)
         if self.use_lag:
             # lag: dispatched scenarios pick up current (W, z) for their
             # NEXT solve (ref. aph.py:671-683 _update_foropt)
@@ -222,8 +277,14 @@ class APH(PHBase):
             self._z_lag = self.z
 
         nu, gamma = self.nu, self.gamma
+        S, S_real = self.batch.S, self._S_orig
         for it in range(1, self.max_iterations + 1):
             self._iter = it
+            rec_on = obs.enabled()
+            if rec_on:
+                pt0 = self._phase_totals()
+                ctr0 = obs.counters_snapshot()
+            t_it = _time.perf_counter()
             xn = self.nonants_of(self.x)
             # Update_y on the previously dispatched set (ref. aph.py:157-186;
             # y ≡ 0 at iter 1 — "iter 1 is iter 0 post-solves")
@@ -242,9 +303,33 @@ class APH(PHBase):
                 xn, self.W, self.y_aph, self.z, self.rho, self.prob,
                 xbar, ybar, nu, gamma, iter1=(it == 1))
             self.xbar, self.xsqbar, self.ybar = xbar, xsqbar, ybar
-            self.tau, self.phi, self.theta = float(tau), float(phi), float(theta)
-            self.conv = float(conv)
-            self.phis = np.asarray(phis)
+            self.phis = phis   # stays on device; the gate ships stats
+            # dispatch & solve (frac forced to 1 at iter 1 "to get a decent
+            # w for everyone", ref. aph.py:783-786). Selection runs on
+            # device and rides the SAME packed gate as the projective
+            # scalars: the iteration's entire host traffic is one row.
+            frac = 1.0 if it == 1 else self.dispatch_frac
+            scnt = max(1, int(np.ceil(S_real * frac)))
+            full = scnt >= S_real
+            if full:
+                gate = scalar_gate(tau, phi, theta, conv, phis,
+                                   S_real=S_real)
+            else:
+                gate = dispatch_gate(tau, phi, theta, conv, phis,
+                                     jnp.asarray(self._last_dispatch),
+                                     scnt=scnt, S_real=S_real)
+            # lint: ok[SYNC001] THE stacked APH gate: one D2H per iteration carries scalars + phi stats + dispatch mask (aph.gate_syncs)
+            g = np.asarray(gate)
+            obs.counter_add("aph.gate_syncs")
+            (self.tau, self.phi, self.theta, self.conv,
+             phi_min, phi_max, phi_neg) = g[:GATE_HEAD].tolist()
+            self._phi_stats = {"phi_min": phi_min, "phi_max": phi_max,
+                               "phi_neg": int(phi_neg)}
+            if full:
+                mask = np.zeros(S, bool)
+                mask[:S_real] = True
+            else:
+                mask = g[GATE_HEAD:] != 0
 
             if self.verbose and (it % 10 == 0 or it == 1):
                 global_toc(f"APH iter {it}: conv={self.conv:.6e} "
@@ -263,11 +348,39 @@ class APH(PHBase):
                            self.verbose)
                 break
             self._ext("miditer")
-            # dispatch & solve (frac forced to 1 at iter 1 "to get a decent
-            # w for everyone", ref. aph.py:783-786)
-            frac = 1.0 if it == 1 else self.dispatch_frac
-            mask = self._dispatch_mask(it, frac)
-            self._aph_solve(mask)
+            cur_bucket = self._shrink.bucket \
+                if self._shrink is not None else None
+            if not full \
+                    and cur_bucket != getattr(self, "_aph_shrink_bucket",
+                                              None):
+                # a compaction bucket transition landed in this
+                # miditer: the solve width changed and every warm
+                # store rebuilds cold (ops/shrink _compact_invalidate)
+                # — dispatch everyone this ONE iteration (the same
+                # warm-up rule as iter 1) so the duals re-materialize
+                # at the new width; partial dispatch resumes next
+                # iteration (doc/aph.md §composition)
+                full = True
+                mask = np.zeros(S, bool)
+                mask[:S_real] = True
+            self._aph_shrink_bucket = cur_bucket
+            didx = None
+            if not full and self._dispatch_capable():
+                didx = np.flatnonzero(mask)
+            self._aph_solve(mask, didx=didx)
+            self._aph_status = {
+                "frac": frac, "scnt": scnt, "S_real": S_real,
+                "dispatched": int(mask.sum()),
+                "solve_path": "chunked-skip" if didx is not None
+                else ("full" if full else "masked-accept"),
+                **(self._phi_stats or {})}
+            if rec_on:
+                t_end = _time.perf_counter()
+                obs.complete_span("ph.iteration", t_it, t_end, cat="ph",
+                                  args={"iter": it})
+                obs.histogram_observe("ph.iteration_seconds", t_end - t_it)
+                obs.event("ph.iteration", self.iteration_record(
+                    it, t_end - t_it, pt0, ctr0))
             self._ext("enditer")
 
         if finalize:
@@ -280,3 +393,51 @@ class APH(PHBase):
 
     def _hub_nonants(self):
         return self.nonants_of(self.x)
+
+    # ---- checkpoint state (ckpt/manager hub bundle extras) ----
+    # The APH wheel's resume needs more than PH's (W, x̄, x̄², ρ): the
+    # projective state (z, y) drives the next θ-step, x feeds the next
+    # y-update, and (phis, last-dispatch, dispatched) reproduce the
+    # next dispatch selection exactly — without them a resumed wheel
+    # would re-dispatch from scratch and the trajectory would fork.
+
+    def aph_state_arrays(self):
+        """Host copies of the APH-specific state, real rows only
+        (mesh pads are reconstructed on install). Keys carry the
+        ``aph_`` prefix so ckpt.bundle treats them as extras."""
+        S_real = self._S_orig
+        # (allowlisted gate site: checkpoint capture is an explicit
+        # D2H at the bundle boundary, never in the iteration loop)
+        return {
+            "aph_z": np.asarray(self.z)[:S_real],
+            "aph_y": np.asarray(self.y_aph)[:S_real],
+            "aph_x": np.asarray(self.x)[:S_real],
+            "aph_phis": np.asarray(self.phis)[:S_real].astype(np.float64),
+            "aph_last_dispatch":
+                np.asarray(self._last_dispatch)[:S_real].astype(np.int64),
+            "aph_dispatched":
+                np.asarray(self._dispatched)[:S_real].astype(np.int64),
+        }
+
+    def install_aph_state(self, arrays):
+        """Inverse of :meth:`aph_state_arrays`: pad the real rows back
+        to the (possibly mesh-padded) S by repeating the last row —
+        exactly extensions/wxbar_io.install_state_arrays's convention —
+        and restore device/host residency per field."""
+        S = self.batch.S
+        t = self.dtype
+
+        def _pad(a):
+            a = np.asarray(a)
+            if a.shape[0] < S:
+                reps = np.repeat(a[-1:], S - a.shape[0], axis=0)
+                a = np.concatenate([a, reps], axis=0)
+            return a
+
+        self.z = jnp.asarray(_pad(arrays["aph_z"]), t)
+        self.y_aph = jnp.asarray(_pad(arrays["aph_y"]), t)
+        self.x = jnp.asarray(_pad(arrays["aph_x"]), t)
+        self.phis = jnp.asarray(_pad(arrays["aph_phis"]), t)
+        self._last_dispatch = _pad(
+            arrays["aph_last_dispatch"]).astype(np.int64)
+        self._dispatched = _pad(arrays["aph_dispatched"]).astype(bool)

@@ -468,8 +468,9 @@ class PHBase(SPBase):
         # doc/extensions.md §shrinking) ----
         self._shrink = None            # active ops/shrink.ShrinkPlan
         self._shrink_factors = {}      # prox_on -> (factors, data_c)
-        self._shrink_allowed = True    # APH opts out (dispatch pools
-        #                                index full-width state)
+        self._shrink_allowed = True    # engines may opt out; APH's
+        #                                PR 13 opt-out is lifted
+        #                                (doc/aph.md §composition)
         self._shrink_status = None     # bench/analyze stamp (plain
         #                                host dict: signal-safe reads)
         if opts.get("shrink_fix") or opts.get("shrink_compact") \
@@ -771,6 +772,10 @@ class PHBase(SPBase):
             cache.pop(("fixed", True), None)
             cache.pop(("chunks", True), None)
             cache.pop(("chunks", ("fixed", True)), None)
+            # dispatch stores carry the flowed factor + rho_scale of
+            # their mode — same lifetime as the chunk states
+            cache.pop(("dispatch", True), None)
+            cache.pop(("dispatch", ("fixed", True)), None)
         # a new rho deserves fresh recovery chances
         self._chunk_no_retry.clear()
         self._hospital_no_retry.clear()
@@ -1010,6 +1015,7 @@ class PHBase(SPBase):
         view reader — rebuilds cold instead of crashing."""
         if key in self._chunk_dirty:
             self._qp_states.pop(("chunks", key), None)
+            self._qp_states.pop(("dispatch", key), None)
             self._qp_states.pop(key, None)
             self._chunk_dirty.discard(key)
             self._chunk_donatable.discard(key)
@@ -1109,6 +1115,60 @@ class PHBase(SPBase):
             self._qp_states[ck] = states
         return self._qp_states[ck]
 
+    def _dispatch_store(self, key, factors, data, slices, stream):
+        """Full-width per-scenario solver-state store for dispatch-
+        masked passes (APH φ-dispatch, doc/aph.md). The positional
+        per-chunk states of the full pass can't warm-start a layout
+        that re-partitions every iteration, so partial passes keep ONE
+        (S, ·) row store: chunk states gather their rows on the way in,
+        successors scatter back after pass 3. Seeded from the last
+        full pass's chunk states (their SCALED iterates, trimmed of
+        chunk pads); cold zeros when none exist (post-compaction /
+        post-rho-invalidation — the same cold restart a rebuilt chunk
+        state takes). L / rho_scale are shared-mode scalars here
+        (chunking requires shared A) and flow like the split loop's."""
+        dk = ("dispatch", key)
+        st = self._qp_states.get(dk)
+        S = self.batch.S
+        if isinstance(st, QPState) and st.x.shape[0] == S:
+            return st
+        chunk_states = self._qp_states.get(("chunks", key))
+        if chunk_states:
+            cw = chunk_states[0].x.shape[0]
+            trims = [r for _, r in self._chunk_index(cw)]
+
+            def catf(f):
+                return jnp.concatenate(
+                    [getattr(s, f)[:r]
+                     for s, r in zip(chunk_states, trims)])
+
+            st = chunk_states[-1]._replace(
+                **{f: catf(f) for f in ("x", "yA", "yB", "zA", "zB",
+                                        "pri_res", "dua_res",
+                                        "pri_rel", "dua_rel")})
+        else:
+            if stream is not None:
+                # one chunk-shaped block for the cold template (direct
+                # fetch, once per store rebuild — never steady-state)
+                b0 = stream.fetch(0)
+                d0 = data._replace(l=b0["l"], u=b0["u"],
+                                   lb=b0["lb"], ub=b0["ub"])
+            else:
+                idx0 = slices[0][0]
+                d0 = data._replace(l=data.l[idx0], u=data.u[idx0],
+                                   lb=data.lb[idx0], ub=data.ub[idx0])
+            st0 = qp_cold_state(factors, d0)
+
+            def zf(a):
+                return jnp.zeros((S,) + a.shape[1:], a.dtype)
+
+            st = st0._replace(
+                **{f: zf(getattr(st0, f))
+                   for f in ("x", "yA", "yB", "zA", "zB", "pri_res",
+                             "dua_res", "pri_rel", "dua_rel")})
+        self._qp_states[dk] = st
+        return st
+
     def _local_chunk(self, chunk):
         """Per-device chunk rows for the sharded chunked loop:
         ``subproblem_chunk`` bounds the per-device microbatch, and the
@@ -1182,7 +1242,8 @@ class PHBase(SPBase):
                 per_scen["ws"] = self._w_scale[:, fs]
         return self._shard_ops.to_chunks(per_scen, lc)
 
-    def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed):
+    def _solve_loop_chunked(self, chunk, w_on, prox_on, update, fixed,
+                            dispatch=None):
         """Host-looped scenario microbatching: S scenarios solved in
         ceil(S/chunk) shared-factor kernel calls, then one global
         membership reduce. This is the single-chip path to the
@@ -1222,6 +1283,11 @@ class PHBase(SPBase):
         as Chrome-trace spans + counters (doc/observability.md)."""
         key = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
         factors, data = self._get_factors(prox_on, fixed)
+        if dispatch is None:
+            # a full-width chunked pass supersedes this mode's dispatch
+            # store (see _dispatch_store: it re-seeds from the pass's
+            # full-width view on the next partial pass)
+            self._qp_states.pop(("dispatch", key), None)
         if factors.A_s.ndim != 2:
             raise ValueError(
                 "subproblem_chunk requires a shared-structure batch "
@@ -1247,7 +1313,40 @@ class PHBase(SPBase):
                                        stream=stream is not None)
         else:
             lc, chs = None, None
-            slices = self._chunk_index(chunk)
+            if dispatch is None:
+                slices = self._chunk_index(chunk)
+            else:
+                # dispatch-masked pass (APH φ-dispatch, doc/aph.md):
+                # microbatch ONLY the dispatched ids — ceil(scnt/chunk)
+                # device calls instead of ceil(S/chunk). Chunks keep
+                # the full ``chunk`` width (same solve program as the
+                # full pass — zero new solve compiles); the tail pads
+                # by repeating the last id, exactly the _chunk_index
+                # convention, so duplicate scatter rows carry identical
+                # values. Scatter-back programs compile per chunk
+                # COUNT — the bucket registry proves compiles track
+                # bucket transitions, not iterations.
+                from ..ops import dispatch as dispatch_ops
+                # lint: ok[SYNC001] host id list (np.flatnonzero of the already-read gate row), not a device value
+                didx = np.asarray(dispatch, dtype=np.int64).ravel()
+                scnt = int(didx.size)
+                if scnt == 0:
+                    raise ValueError("dispatch id list is empty")
+                n_dchunks = -(-scnt // chunk)
+                pad_n = n_dchunks * chunk - scnt
+                ids_pad = np.concatenate(
+                    [didx, np.full(pad_n, didx[-1])]) if pad_n else didx
+                slices = [(jnp.asarray(ids_pad[i * chunk:(i + 1) * chunk]),
+                           min(chunk, scnt - i * chunk))
+                          for i in range(n_dchunks)]
+                dispatch_ops.register_bucket({
+                    "n_chunks": n_dchunks, "chunk": chunk,
+                    "S": self.batch.S, "mode": _mode_str(key),
+                    "shrink": None if shrink is None else shrink.bucket,
+                    "stream": stream is not None})
+                obs.counter_add("dispatch.solved_scenarios", scnt)
+                obs.counter_add("dispatch.skipped_scenarios",
+                                max(self._S_orig - scnt, 0))
             if shrink is not None:
                 fs = shrink.free_slots_dev
                 a_c, a_W = shrink.c_c, self.W[:, fs]
@@ -1269,28 +1368,68 @@ class PHBase(SPBase):
             # actual layout change (once per (chunk, S), never
             # steady-state — the per-call spelling would be a small
             # D2H per iteration).
-            lkey = (("sharded", lc, self.batch.S) if sharded
-                    else ("host", chunk, self.batch.S))
-            if stream.bound_key != lkey:
-                # lint: ok[SYNC001] layout staging once per chunk-layout change (guarded by bound_key above), never per iteration
-                stream.bind(lkey, [np.asarray(idx) for idx, _ in slices])
+            if dispatch is not None:
+                # dispatch-driven staging: bind to THIS iteration's id
+                # set so the source stages ONLY the dispatched chunks —
+                # the composition ROADMAP item 3 names. The sequence
+                # number makes every partial pass a fresh layout (the
+                # id set changes with φ); the per-pass pipeline rebuild
+                # is host thread churn, amortized by the chunks NOT
+                # staged.
+                self._dispatch_bind_seq = \
+                    getattr(self, "_dispatch_bind_seq", 0) + 1
+                lkey = ("dispatch", chunk, self.batch.S,
+                        self._dispatch_bind_seq)
+                stream.bind(lkey, [ids_pad[i * chunk:(i + 1) * chunk]
+                                   for i in range(n_dchunks)])
+            else:
+                lkey = (("sharded", lc, self.batch.S) if sharded
+                        else ("host", chunk, self.batch.S))
+                if stream.bound_key != lkey:
+                    # lint: ok[SYNC001] layout staging once per chunk-layout change (guarded by bound_key above), never per iteration
+                    arrs = [np.asarray(idx) for idx, _ in slices]
+                    stream.bind(lkey, arrs)
         self._drop_if_dirty(key)
-        if stream is not None \
-                and ("chunks", key) not in self._qp_states:
-            # cold chunk states need one chunk-shaped data block; a
-            # direct fetch outside the pipeline's in-order pass (once
-            # per mode rebuild, never steady-state)
-            b0 = stream.fetch(0)
-            cold_d = data._replace(l=b0["l"], u=b0["u"],
-                                   lb=b0["lb"], ub=b0["ub"])
-        fresh_states = ("chunks", key) not in self._qp_states
-        states = self._ensure_chunk_states(key, factors, data, slices,
-                                           chunks=chs, lc=lc,
-                                           cold_data=cold_d)
-        if fresh_states:
-            # rebuilt chunk states share cold-state buffers — donation
-            # must wait for the first completed pass to privatize them
-            self._chunk_donatable.discard(key)
+        if dispatch is not None:
+            # full-width per-scenario warm store: per-chunk positional
+            # states can't serve a layout that re-partitions every
+            # iteration, so dispatch passes gather their chunk states
+            # from one (S, ·) row store and scatter successors back
+            states = None
+            dstore = self._dispatch_store(key, factors, data, slices,
+                                          stream)
+        else:
+            dstore = None
+            if stream is not None \
+                    and ("chunks", key) not in self._qp_states:
+                # cold chunk states need one chunk-shaped data block; a
+                # direct fetch outside the pipeline's in-order pass
+                # (once per mode rebuild, never steady-state)
+                b0 = stream.fetch(0)
+                cold_d = data._replace(l=b0["l"], u=b0["u"],
+                                       lb=b0["lb"], ub=b0["ub"])
+            fresh_states = ("chunks", key) not in self._qp_states
+            states = self._ensure_chunk_states(key, factors, data, slices,
+                                               chunks=chs, lc=lc,
+                                               cold_data=cold_d)
+            if fresh_states:
+                # rebuilt chunk states share cold-state buffers —
+                # donation must wait for the first completed pass to
+                # privatize them
+                self._chunk_donatable.discard(key)
+        if dispatch is not None:
+            from ..ops.dispatch import gather_rows
+            states = [dstore._replace(
+                x=gather_rows(dstore.x, idx),
+                yA=gather_rows(dstore.yA, idx),
+                yB=gather_rows(dstore.yB, idx),
+                zA=gather_rows(dstore.zA, idx),
+                zB=gather_rows(dstore.zB, idx),
+                pri_res=gather_rows(dstore.pri_res, idx),
+                dua_res=gather_rows(dstore.dua_res, idx),
+                pri_rel=gather_rows(dstore.pri_rel, idx),
+                dua_rel=gather_rows(dstore.dua_rel, idx))
+                for idx, _ in slices]
         polish_chunk = int(self.options.get("subproblem_polish_chunk", 0))
         from ..ops.qp_solver import SplitMatrix
         split_mode = isinstance(factors.A_s, SplitMatrix)
@@ -1315,7 +1454,11 @@ class PHBase(SPBase):
                   segment_lo=self.sub_segment_lo,
                   ir_sweeps=self.sub_ir_sweeps, kernel=plan)
         pipeline = bool(int(self.options.get("subproblem_pipeline", 1)))
+        # dispatch passes never donate: every gathered chunk state
+        # aliases the dispatch store's single flowed factor, so the
+        # first donated solve would delete the buffer chunk 2 needs
         donate = pipeline and key in self._chunk_donatable \
+            and dispatch is None \
             and bool(int(self.options.get("subproblem_donate", 1)))
         if donate:
             self._chunk_dirty.add(key)   # cleared after pass 3 stores
@@ -1757,6 +1900,13 @@ class PHBase(SPBase):
                 xn, base, solved, dual = _ph_chunk_objs(
                     x, yA, yB, d_h, q_h, c_c, c0_c, P0_c,
                     self.nonant_idx, W_c, w_on=bool(w_on))
+            if dispatch is not None:
+                # keep the pad rows: the scatter-back writes the PADDED
+                # width (duplicate ids carry identical values, so the
+                # unordered scatter is still deterministic) — trimming
+                # would make the scatter shape vary per scnt instead of
+                # per chunk-count bucket
+                real = x.shape[0]
             for k, v in (("x", x[:real]), ("yA", yA[:real]),
                          ("yB", yB[:real]), ("xn", xn[:real]),
                          ("base", base[:real]), ("solved", solved[:real]),
@@ -1775,6 +1925,42 @@ class PHBase(SPBase):
         # owned buffers — the NEXT pass of this mode may donate them,
         # and this pass's donation window is closed
         self._chunk_dirty.discard(key)
+        if dispatch is not None:
+            # scatter-back: the dispatched rows' results land in the
+            # full-width arrays; every other row — solution, duals,
+            # warm state, objectives — carries forward untouched (the
+            # staleness contract, doc/aph.md). Store rows take the
+            # SCALED post-solve states (warm-start semantics); the
+            # engine-facing x/yA/yB take the unscaled solutions.
+            from ..ops.dispatch import scatter_rows
+            ids_dev = jnp.asarray(ids_pad)
+            cat = {k: jnp.concatenate(v) for k, v in parts.items()}
+            srows = {f: jnp.concatenate([getattr(s, f) for s in states])
+                     for f in ("x", "yA", "yB", "zA", "zB", "pri_res",
+                               "dua_res", "pri_rel", "dua_rel")}
+            last = states[-1]
+            new_store = dstore._replace(
+                L=last.L, rho_scale=last.rho_scale, iters=last.iters,
+                **{f: scatter_rows(getattr(dstore, f), ids_dev, srows[f])
+                   for f in srows})
+            self._qp_states[("dispatch", key)] = new_store
+            # the full-width store doubles as this mode's QPState for
+            # the read-only consumers (residual_summary, feasibility
+            # checks, warm-start transplants)
+            self._qp_states[key] = new_store
+            self.x = scatter_rows(self.x, ids_dev, cat["x"])
+            self.yA = scatter_rows(self.yA, ids_dev, cat["yA"])
+            self.yB = scatter_rows(self.yB, ids_dev, cat["yB"])
+            self._last_base_obj = scatter_rows(
+                jnp.asarray(self._last_base_obj), ids_dev, cat["base"])
+            self._last_solved_obj = scatter_rows(
+                jnp.asarray(self._last_solved_obj), ids_dev,
+                cat["solved"])
+            self._last_dual_obj = scatter_rows(
+                jnp.asarray(self._last_dual_obj), ids_dev, cat["dual"])
+            _lap("reduce")
+            self._ext("post_solve")
+            return self._last_solved_obj
         self._chunk_donatable.add(key)
         # reassembly: sharded chunks concatenate LOCALLY per device
         # (each device's chunk rows are exactly its contiguous shard —
@@ -1904,6 +2090,16 @@ class PHBase(SPBase):
                             # the (rare) eager L⁻¹ builds and bf16 gate
                             # trips — the analyze fused-vs-segmented
                             # verdict row reads these
+                            # APH φ-dispatch (ops/dispatch, doc/aph.md):
+                            # one gate sync per iteration, solved vs
+                            # skipped scenario counts, and bucket
+                            # compile-vs-hit activity — the analyze aph
+                            # section and its compare verdict read these
+                            "aph.gate_syncs",
+                            "dispatch.solved_scenarios",
+                            "dispatch.skipped_scenarios",
+                            "dispatch.bucket.compile",
+                            "dispatch.bucket.cache_hit",
                             "kernel.fused_iters",
                             "kernel.l_inv_factorizations",
                             "kernel.bf16_fallbacks",
@@ -1962,6 +2158,13 @@ class PHBase(SPBase):
             # staging totals as plain host ints — per-iteration deltas
             # ride counter_deltas below
             rec["stream"] = self._stream_source.status()
+        aph = getattr(self, "_aph_status", None)
+        if aph:
+            # APH dispatch anatomy (doc/aph.md): this iteration's
+            # dispatched fraction, φ stats from the packed gate, and
+            # which solve path carried it — analyze's aph section plots
+            # the trajectory and the skipped-solve savings
+            rec["aph"] = dict(aph)
         now = self._phase_totals()
         rec["phase_seconds"] = {k: now[k] - phase_before.get(k, 0.0)
                                 for k in now}
@@ -2166,14 +2369,23 @@ class PHBase(SPBase):
                 jnp.concatenate(feas), st)
 
     # ------------- the fused PH step -------------
-    def solve_loop(self, w_on=True, prox_on=True, update=True, fixed=False):
+    def solve_loop(self, w_on=True, prox_on=True, update=True, fixed=False,
+                   dispatch=None):
         """One batched solve pass in the given mode; mirrors solve_loop
         (ref. phbase.py:999) + Compute_Xbar + Update_W fused. Returns the
         per-scenario *solved* objective (including the W term when w_on,
         which is what Ebound of a Lagrangian pass needs). ``fixed=True``
         selects the eq-boosted factorization for fully-pinned solves.
         With ``subproblem_chunk`` set below S, the solve microbatches
-        over scenario chunks (see _solve_loop_chunked)."""
+        over scenario chunks (see _solve_loop_chunked).
+
+        ``dispatch`` (host int array of ascending scenario ids, APH's
+        φ-dispatch — doc/aph.md): solve ONLY those scenarios. The
+        dispatched ids microbatch into full-size chunks and scatter
+        back; every other scenario's solution, duals, warm state, and
+        objectives carry forward unchanged. Host-chunked loop only,
+        and the pass must not run the W/x̄ update (the caller owns the
+        reduction semantics over a partial solve)."""
         t0 = _time.perf_counter()
         obs.counter_add("ph.solve_loop_calls")
         chunk = int(self.options.get("subproblem_chunk", 0))
@@ -2190,9 +2402,21 @@ class PHBase(SPBase):
                 "subproblem_chunk must be positive and below the "
                 f"(per-device) scenario count (got chunk={chunk}, "
                 f"S={self.batch.S}) — see doc/streaming.md")
+        if dispatch is not None:
+            if not chunked or sh is not None:
+                raise ValueError(
+                    "dispatch-masked solves require the HOST-chunked "
+                    "loop (subproblem_chunk below S on a single "
+                    "device); sharded/fused engines use masked "
+                    "acceptance instead — see doc/aph.md")
+            if update:
+                raise ValueError(
+                    "dispatch-masked solves cannot run the W/xbar "
+                    "update: the reduction would mix fresh and stale "
+                    "rows silently (APH owns its own reduce)")
         if chunked:
             out = self._solve_loop_chunked(chunk, w_on, prox_on, update,
-                                           fixed)
+                                           fixed, dispatch=dispatch)
             if self._timing:
                 # lint: ok[SYNC001] opt-in timing sync (report_timing), off by default
                 jax.block_until_ready(self.x)
@@ -2208,6 +2432,9 @@ class PHBase(SPBase):
         # only chunked ones. t_mark starts after the factor fetch: a
         # first-call factorization is setup, not iteration anatomy.
         skey = ("fixed", bool(prox_on)) if fixed else bool(prox_on)
+        # a full-width pass supersedes this mode's dispatch store (its
+        # rows would go stale the moment the fused solve lands)
+        self._qp_states.pop(("dispatch", skey), None)
         ent = self._phase_times.setdefault(
             skey, {"acc": {"assemble": 0.0, "solve": 0.0, "gate": 0.0,
                            "reduce": 0.0},

@@ -461,6 +461,55 @@ def streaming_summary(run: Run) -> dict | None:
     }
 
 
+def aph_summary(run: Run) -> dict | None:
+    """APH φ-dispatch activity (core/aph.py + ops/dispatch.py,
+    doc/aph.md): the dispatched-fraction trajectory, φ histogram
+    stats, skipped-solve savings, dispatch-bucket compile behavior,
+    and THE pacing signal — gate syncs per iteration (the stacked-gate
+    contract says exactly one D2H per APH iteration). None when no
+    APH wheel ran — the section only renders for APH telemetry."""
+    tot = {}
+    for role in run.metrics:
+        for k, v in run.counters(role).items():
+            if k.startswith(("aph.", "dispatch.")):
+                tot[k] = tot.get(k, 0) + v
+    rows = [e for e in iteration_rows(run) if e.get("aph")]
+    if not tot and not rows:
+        return None
+    traj = [{"iter": e["iter"],
+             "frac": e["aph"].get("frac"),
+             "dispatched": e["aph"].get("dispatched"),
+             "S_real": e["aph"].get("S_real"),
+             "solve_path": e["aph"].get("solve_path"),
+             "phi_min": e["aph"].get("phi_min"),
+             "phi_max": e["aph"].get("phi_max"),
+             "phi_neg": e["aph"].get("phi_neg")}
+            for e in rows]
+    iters = len(rows)
+    syncs = int(tot.get("aph.gate_syncs", 0))
+    solved = int(tot.get("dispatch.solved_scenarios", 0))
+    skipped = int(tot.get("dispatch.skipped_scenarios", 0))
+    last = rows[-1]["aph"] if rows else {}
+    return {
+        "iterations": iters,
+        "dispatch_frac": last.get("frac"),
+        "solve_path": last.get("solve_path"),
+        "gate_syncs": syncs,
+        # the O(1)-host-traffic acceptance signal: must sit at ~1.0
+        "gate_syncs_per_iteration": (syncs / iters) if iters else None,
+        "solved_scenarios": solved,
+        "skipped_scenarios": skipped,
+        # fraction of scenario-solves partial dispatch saved outright
+        "skipped_solve_savings":
+            (skipped / (solved + skipped)) if (solved + skipped) else None,
+        "solved_per_iteration": (solved / iters) if iters else None,
+        "bucket_compiles": int(tot.get("dispatch.bucket.compile", 0)),
+        "bucket_cache_hits": int(tot.get("dispatch.bucket.cache_hit", 0)),
+        "phi_neg_final": last.get("phi_neg"),
+        "trajectory": traj,
+    }
+
+
 def checkpoint_summary(run: Run) -> dict | None:
     """Durable checkpoint activity (mpisppy_tpu.ckpt,
     doc/fault_tolerance.md): ``ckpt.*`` counters summed across roles
@@ -1071,6 +1120,31 @@ def render_report(run: Run) -> str:
                         "leaked (see per_iteration in --json)"))
         L.append("")
 
+    ap = aph_summary(run)
+    if ap is not None:
+        L.append("== aph ==")
+        sav = ap["skipped_solve_savings"]
+        L.append(f"dispatch_frac {_fmt(ap['dispatch_frac'], 3)}  "
+                 f"path {ap['solve_path'] or '?'}  solved "
+                 f"{ap['solved_scenarios']}  skipped "
+                 f"{ap['skipped_scenarios']}"
+                 + (f"  (savings {_fmt(sav, 3)})"
+                    if sav is not None else ""))
+        gpi = ap["gate_syncs_per_iteration"]
+        L.append(f"gate syncs {ap['gate_syncs']}"
+                 + (f"  ({_fmt(gpi, 2)}/iter — the stacked-gate "
+                    "contract says 1)" if gpi is not None else "")
+                 + f"  bucket compiles {ap['bucket_compiles']}  "
+                 f"bucket cache hits {ap['bucket_cache_hits']}")
+        tr = [t for t in ap["trajectory"]
+              if t.get("dispatched") is not None]
+        if tr:
+            L.append("dispatched trajectory (iter: n/S φneg): "
+                     + "  ".join(
+                         f"{t['iter']}: {t['dispatched']}/{t['S_real']} "
+                         f"{t['phi_neg']}" for t in tr[-8:]))
+        L.append("")
+
     inc = incumbent_summary(run)
     if inc is not None:
         L.append("== incumbent ==")
@@ -1093,7 +1167,8 @@ def render_report(run: Run) -> str:
     L.append("== counters ==")
     for k in sorted(c):
         if k.split(".")[0] in ("ph", "qp", "hub", "spoke", "incumbent",
-                               "serve", "shrink", "stream"):
+                               "serve", "shrink", "stream", "aph",
+                               "dispatch"):
             L.append(f"  {k} = {_fmt(c[k])}")
     L.append("")
 
@@ -1316,6 +1391,32 @@ def compare(a: Run, b: Run, threshold=1.5,
             f"int8_fallbacks={sm['int8_fallbacks']}"
             + (f" occupancy={_fmt(occ, 3)}" if occ is not None else "")
             + f" — steady-state device_put verdict [{verdict}]")
+    # APH dispatch verdict row (ISSUE 16, doc/aph.md): at EQUAL
+    # dispatch_frac, the φ-dispatch promise is that B launches no more
+    # scenario-solves per iteration than A — a grown count means the
+    # skip machinery silently degraded to full-width launches (the
+    # exact regression the counter exists to catch). Different fracs
+    # are a config change, not a regression; the row says so and
+    # abstains.
+    apa, apb = aph_summary(a), aph_summary(b)
+    if apa is not None and apb is not None:
+        va = apa.get("solved_per_iteration")
+        vb = apb.get("solved_per_iteration")
+        fa, fb = apa.get("dispatch_frac"), apb.get("dispatch_frac")
+        if fa is not None and fb is not None and fa != fb:
+            L.append(f"  aph: dispatch_frac differs (A={_fmt(fa, 3)} "
+                     f"B={_fmt(fb, 3)}) — dispatch verdict [skipped]")
+        elif va is not None and vb is not None:
+            verdict = "PASS"
+            if vb > va + 0.5:
+                verdict = "REGRESSION"
+                regressions.append("aph_dispatched_solves")
+            L.append(
+                f"  aph: solved/iter A={_fmt(va)} B={_fmt(vb)} "
+                f"(frac {_fmt(fa, 3)})  gate syncs/iter "
+                f"A={_fmt(apa['gate_syncs_per_iteration'], 2)} "
+                f"B={_fmt(apb['gate_syncs_per_iteration'], 2)} — "
+                f"dispatch verdict [{verdict}]")
     # per-iteration-time-vs-active-set verdict row (ISSUE 14,
     # doc/extensions.md §shrinking): for a run with compactions, the
     # shrinking promise is that post-compaction iterations get
@@ -1551,6 +1652,8 @@ def main(argv=None) -> int:
                                 "b": shrink_summary(b)},
                      "streaming": {"a": streaming_summary(a),
                                    "b": streaming_summary(b)},
+                     "aph": {"a": aph_summary(a),
+                             "b": aph_summary(b)},
                      "verdict": "PASS" if passed else "REGRESSION"}))
             else:
                 print(text)
@@ -1572,6 +1675,7 @@ def main(argv=None) -> int:
                 "sharding": sharding_summary(run),
                 "shrink": shrink_summary(run),
                 "streaming": streaming_summary(run),
+                "aph": aph_summary(run),
                 "incumbent": incumbent_summary(run),
                 "checkpoint": checkpoint_summary(run),
                 "serving": serving_summary(run),

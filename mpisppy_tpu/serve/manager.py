@@ -528,9 +528,11 @@ class ServeService:
         for r in group:
             rem = r.deadline_remaining()
             if rem is not None and rem <= 0:
+                # counter BEFORE the status flip: a poller that sees
+                # "failed" must already see the miss booked
+                obs.counter_add("serve.requests.deadline_missed")
                 self._finish(r, "failed", error="deadline expired in "
                                                 "queue")
-                obs.counter_add("serve.requests.deadline_missed")
             else:
                 live.append(r)
         return live
@@ -666,9 +668,12 @@ class ServeService:
                     self.store.save(r)
                     self.queue.push(r, front=True, force=True)
                 return
+            # counter BEFORE the status flip (same contract as the
+            # chain path): a poller that sees "failed" must already
+            # see the miss booked
+            obs.counter_add("serve.requests.deadline_missed")
             self._finish(group[0], "failed",
                          error="wheel deadline exceeded")
-            obs.counter_add("serve.requests.deadline_missed")
             return
         for r, res in zip(group, wheel["results"]):
             self._finish(r, "done", result={**res, "wheel": wheel["stamp"]})

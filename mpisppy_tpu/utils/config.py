@@ -125,6 +125,14 @@ class AlgoConfig:
     #                                 (explicit opt-in, host-side gate)
     stream_int8_tol: float = 1e-3   # gate: max per-entry recon error
     stream_depth: int = 2           # prefetch pipeline double-buffer
+    # ---- APH φ-dispatch (core/aph.py + ops/dispatch.py, doc/aph.md):
+    # fraction of scenarios solved per iteration (most-negative-φ first,
+    # least-recently-dispatched fill; ref. aph.py dispatch_frac) plus
+    # the ν/γ projective-step parameters. 1.0 = full dispatch (every
+    # scenario solves; bit-identical to the pre-dispatch engine) ----
+    dispatch_frac: float = 1.0      # ∈ (0, 1]; partial needs hub="aph"
+    aph_nu: float = 1.0             # APHnu: step scale θ = ν·φ/τ
+    aph_gamma: float = 1.0          # APHgamma: z-update damping
     linearize_proximal_terms: bool = False   # accepted + ignored (see ph.py)
     verbose: bool = False
 
@@ -163,6 +171,14 @@ class AlgoConfig:
             "stream_int8": self.stream_int8,
             "stream_int8_tol": self.stream_int8_tol,
             "stream_depth": self.stream_depth,
+            # APH knobs ride to_options() under the reference's names so
+            # they reach the engine AND the serve bucket fingerprint (a
+            # partial-dispatch APH engine compiles dispatch-width
+            # buckets a full-dispatch engine never sees — the leases
+            # must not mix)
+            "dispatch_frac": self.dispatch_frac,
+            "APHnu": self.aph_nu,
+            "APHgamma": self.aph_gamma,
             "verbose": self.verbose,
         }
 
@@ -218,6 +234,14 @@ class AlgoConfig:
             raise ValueError("stream_int8_tol must be positive")
         if self.stream_depth < 1:
             raise ValueError("stream_depth must be >= 1")
+        if not (0.0 < self.dispatch_frac <= 1.0):
+            raise ValueError(f"dispatch_frac must lie in (0, 1]; got "
+                             f"{self.dispatch_frac}")
+        if self.aph_nu <= 0:
+            raise ValueError("aph_nu must be positive (θ = ν·φ/τ)")
+        if self.aph_gamma <= 0:
+            raise ValueError("aph_gamma must be positive (z-update "
+                             "damping γ)")
         if self.scenario_source != "resident" and self.shrink_compact:
             raise ValueError(
                 "shrink_compact folds FULL-width data constants and "
@@ -387,6 +411,11 @@ class RunConfig:
                 if v is not None and int(v) < 0:
                     raise ValueError(f"coordinator.{k} must be >= 0")
         self.algo.validate()
+        if self.algo.dispatch_frac < 1.0 and self.hub != "aph":
+            raise ValueError(
+                "dispatch_frac < 1 is φ-based partial dispatch — only "
+                "the APH hub scores φ and can skip solves (hub='aph'); "
+                "synchronous PH must solve every scenario each iteration")
         for sp in self.spokes:
             sp.validate()
         if self.hub == "lshaped" and any(

@@ -150,6 +150,12 @@ HOT_LOOP_DEFAULT = (
     # the source/pipeline serializes the chunk chain exactly like one
     # in core/ph
     "mpisppy_tpu/stream/",
+    # the device-paced APH wheel (ISSUE 16, doc/aph.md): the whole
+    # iteration's host traffic is ONE stacked gate read — any other
+    # readback in the loop or the dispatch ops breaks the O(1)
+    # aph.gate_syncs contract
+    "mpisppy_tpu/core/aph.py",
+    "mpisppy_tpu/ops/dispatch.py",
 )
 
 # modules that document themselves jax-free (CHANGES/doc claims backed
@@ -212,6 +218,14 @@ SYNC_ALLOW_DEFAULT = {
         "PHBase.evaluate_incumbent_pool":
             "pool staging + the ONE stacked verdict D2H per round "
             "(O(1) asserted by tests/test_incumbent.py)",
+    },
+    "mpisppy_tpu/core/aph.py": {
+        "APH.aph_state_arrays":
+            "checkpoint capture: explicit D2H at the bundle boundary "
+            "(ckpt/manager), never in the iteration loop",
+        "APH.install_aph_state":
+            "checkpoint resume installer: runs once before the wheel "
+            "starts",
     },
     "mpisppy_tpu/ops/qp_solver.py": {
         "_trace_seg":
