@@ -519,6 +519,14 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
                     "bucket": st.get("bucket"),
                     "est_hbm_bytes_per_iter":
                         st.get("est_hbm_bytes_per_iter"),
+                    # ISSUE 17: how the bucket transitions restarted —
+                    # warm counts are transplanted mode states, cold
+                    # counts are booked fallbacks (a healthy wheel
+                    # shows cold == 0; growth is a regression signal
+                    # analyze --compare reads)
+                    "transplant": {
+                        "warm": st.get("transplants", 0),
+                        "cold": st.get("transplant_cold", 0)},
                 }
         except Exception:
             pass    # a kill-path flush must never die on diagnostics
@@ -809,6 +817,14 @@ def bench_aph_crossover():
                    "rel_gap_vs_ph": round(gap, 6),
                    "solved_per_iter":
                        round(solved / max(ITERS, 1), 1) if solved else None}
+            # ISSUE 17: where shrinking is armed, stamp how the bucket
+            # transitions restarted (warm transplants vs booked cold
+            # fallbacks) — same shape as the gap rows' active block
+            sst = getattr(opt, "_shrink_status", None)
+            if sst:
+                row["transplant"] = {
+                    "warm": sst.get("transplants", 0),
+                    "cold": sst.get("transplant_cold", 0)}
             emit(dict(row, metric="aph_crossover_s_per_iter",
                       value=round(dt / (ITERS + 1), 4),
                       unit="s/iter (wall incl. iter0; jit cache shared "
@@ -838,12 +854,16 @@ def bench_uc1024_gap():
     # were starved by the driver kill.
     _run_gap_wheel(
         batch, "uc1024", baseline_s=0.0, max_iterations=28,
-        # progressive shrinking (ISSUE 14): the device fixer pins
-        # consensus-stable binaries so the gap row's ``active`` block
-        # records the fixed-fraction trajectory (the df32 hub keeps
-        # the pin-boxes path — compaction engages on dense layouts)
+        # progressive shrinking: the device fixer pins consensus-stable
+        # binaries (ISSUE 14) and — now that the compacted gather
+        # understands the df32 SplitMatrix layout (ISSUE 17) — the
+        # active set COMPACTS on the production representation too,
+        # with warm-state transplants across bucket transitions. The
+        # gap row's ``active`` block records the fixed-fraction
+        # trajectory plus the transplant={warm,cold} counts.
         hub_extra={"shrink_fix": True, "shrink_fix_iters": 4,
-                   "shrink_fix_tol": 1e-3},
+                   "shrink_fix_tol": 1e-3, "shrink_compact": True,
+                   "shrink_buckets": "0.25,0.5,0.75"},
         lag_extra={"lagrangian_device_duals": True},
         # consensus-rounded candidates alternate with the oracle
         # plans: the union-of-MILP-plans incumbent over-commits, and

@@ -88,6 +88,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "diagonal)")
     p.add_argument("--shrink-rho-interval", type=int, default=1,
                    help="iterations between per-slot rho update passes")
+    p.add_argument("--no-shrink-transplant", action="store_true",
+                   help="disable the warm-state transplant across "
+                        "compaction bucket transitions (states rebuild "
+                        "cold, the pre-transplant spelling; transplant "
+                        "is on by default when --shrink-compact is)")
     # scenario streaming (mpisppy_tpu/stream, doc/streaming.md)
     p.add_argument("--scenario-source", choices=STREAM_SOURCES,
                    default="resident",
@@ -236,6 +241,7 @@ def config_from_args(args) -> RunConfig:
         shrink_buckets=args.shrink_buckets,
         shrink_rho=args.shrink_rho,
         shrink_rho_interval=args.shrink_rho_interval,
+        shrink_transplant=not args.no_shrink_transplant,
         scenario_source=args.scenario_source,
         stream_int8=args.stream_int8,
         stream_int8_tol=args.stream_int8_tol,

@@ -486,6 +486,7 @@ class PHBase(SPBase):
                 "fixed": 0, "free": batch.K, "compactions": 0,
                 "bucket": 0.0, "n_cols": int(batch.n),
                 "m_rows": int(batch.m),
+                "transplants": 0, "transplant_cold": 0,
                 "est_hbm_bytes_per_iter": self._shrink_est_hbm(
                     int(batch.n), int(batch.m))}
             # CLI/serve wiring: options carry the knobs but the ctor
@@ -802,6 +803,8 @@ class PHBase(SPBase):
         with full data."""
         key = bool(prox_on)
         if key not in self._shrink_factors:
+            from ..ops.qp_solver import (ScaledView, SplitMatrix,
+                                         qp_setup_like)
             plan = self._shrink
             d = plan.data_c
             if prox_on:
@@ -823,7 +826,33 @@ class PHBase(SPBase):
                     # batched per-scenario quadratic: rho adds per row
                     d = d._replace(P_diag=d.P_diag.at[:, plan.idx_c].add(
                         self.rho[:, plan.free_slots_dev]))
-            fac = qp_setup(d, q_ref=plan.c_c)
+            if isinstance(d.A, (SplitMatrix, ScaledView)):
+                # df32 compacted factors follow the full cache's
+                # discipline (_get_factors): modes of ONE transition
+                # share one equilibration + scaled compacted split
+                # (qp_setup_like), and every consumer reads A through
+                # the scaled view so the raw compacted pair frees. The
+                # base is pinned on the plan, not just this cache —
+                # after the first mode build data_c.A IS the view, so a
+                # later mode (or a rho-invalidated rebuild) can no
+                # longer run a from-scratch qp_setup
+                base = next(
+                    (f for f, _ in self._shrink_factors.values()),
+                    None) or getattr(plan, "fac_base", None)
+                if base is not None and isinstance(base.A_s, SplitMatrix):
+                    fac = qp_setup_like(base, d)
+                else:
+                    fac = qp_setup(d, q_ref=plan.c_c)
+                if isinstance(fac.A_s, SplitMatrix):
+                    plan.fac_base = fac
+                    if isinstance(d.A, SplitMatrix):
+                        view = ScaledView(fac.A_s, fac.D, fac.E)
+                        d = d._replace(A=view)
+                        # later modes and pass-3 consumers read the
+                        # plan's data through the same view
+                        plan.data_c = plan.data_c._replace(A=view)
+            else:
+                fac = qp_setup(d, q_ref=plan.c_c)
             self._shrink_factors[key] = (fac, d)
         return self._shrink_factors[key]
 
@@ -858,8 +887,12 @@ class PHBase(SPBase):
         the rows they touch) into a smaller packed system, re-factorize
         once, and solve THAT until the next transition. Returns True
         when a compaction happened. No-op unless ``shrink_compact`` is
-        enabled and the engine's structure supports it (shared dense A;
-        the df32 split representation keeps the pin-boxes path)."""
+        enabled and the engine's structure supports it: shared dense A,
+        the df32 split representation (SplitMatrix / ScaledView —
+        ops/shrink gathers both f32 planes), and streamed sources
+        (one out-of-band full restage feeds build_plan, then the host
+        store re-blocks at the compacted width). Packed split matvec
+        forms and synthesized sources keep the pin-boxes path."""
         if not bool(self.options.get("shrink_compact")):
             return False
         if nfixed is None:
@@ -875,19 +908,26 @@ class PHBase(SPBase):
         current = self._shrink.bucket if self._shrink is not None else 0.0
         if target is None or target <= current:
             return False
-        if not self._shrink_allowed \
-                or self._stream_source is not None \
-                or not isinstance(self.qp_data.A, jax.Array) \
-                or getattr(self.qp_data.A, "ndim", 0) not in (2, 3):
-            # df32 SplitMatrix / ScaledView / packed layouts: the
-            # compacted gather is not defined for them (yet) — fixing
-            # still pays off through the pin boxes. Streamed/
-            # synthesized sources skip too (build_plan folds FULL-width
-            # data constants the engine deliberately never ships;
-            # AlgoConfig.validate already rejects the CLI combination —
-            # this guards programmatic options). Booked once per
-            # TARGET bucket (the layout stays unsupported every
-            # iteration; a per-call count would tally iterations)
+        from ..ops.qp_solver import ScaledView, SplitMatrix
+        A_full = self.qp_data.A
+        pat = A_full.A_s if isinstance(A_full, ScaledView) else A_full
+        dense_ok = isinstance(A_full, jax.Array) \
+            and getattr(A_full, "ndim", 0) in (2, 3)
+        # packed split forms carry structure-dependent matvec index
+        # planes the column gather cannot re-derive — they skip
+        split_ok = isinstance(pat, SplitMatrix) and pat.struct is None
+        stream = self._stream_source
+        stream_ok = stream is None or stream.kind == "streamed"
+        if not self._shrink_allowed or not (dense_ok or split_ok) \
+                or not stream_ok:
+            # unsupported layout/source: fixing still pays off through
+            # the pin boxes. Synthesized sources skip (the generator
+            # manufactures FULL-width blocks in-kernel; there is no
+            # host store to re-block — AlgoConfig.validate already
+            # rejects the CLI combination, this guards programmatic
+            # options). Booked once per TARGET bucket (the layout
+            # stays unsupported every iteration; a per-call count
+            # would tally iterations)
             noted = getattr(self, "_shrink_skip_noted", None)
             if noted is None:
                 noted = self._shrink_skip_noted = set()
@@ -904,8 +944,19 @@ class PHBase(SPBase):
             # no rows left): build_plan's host staging must not re-run
             # every miditer — the once-per-transition contract
             return False
+        qd, c_full = self.qp_data, self.c
+        if stream is not None:
+            # ONE out-of-band full restage: build_plan folds the TRUE
+            # full-width blocks (the engine's resident qp_data carries
+            # 2-row setup surrogates under streaming); its bytes book
+            # on stream.compacted_restage_bytes, never the
+            # per-iteration bytes_shipped flatness signal
+            full = stream.stage_full()
+            qd = qd._replace(l=full["l"], u=full["u"],
+                             lb=full["lb"], ub=full["ub"])
+            c_full = full["c"]
         plan = shrink_ops.build_plan(
-            self.qp_data, self.c, self.c0, self.nonant_idx,
+            qd, c_full, self.c0, self.nonant_idx,
             self._fixed_mask, self._fixed_vals, target,
             dtype=self.dtype,
             ident={"kernel_mode": self.sub_kernel_mode,
@@ -916,6 +967,29 @@ class PHBase(SPBase):
             noted.add(target)
             obs.counter_add("shrink.compaction_skipped")
             return False
+        if stream is not None:
+            # re-block the host store at the compacted width, then swap
+            # the plan's per-scenario blocks for 2-row setup surrogates
+            # over that store — the hot loop keeps staging per chunk,
+            # now at the compacted width (the folded full blocks the
+            # plan was built with must NOT stay resident; that is the
+            # residency streaming exists to bound)
+            stream.install_compacted(plan)
+            l2, u2, lb2, ub2, c2 = stream.setup_arrays(
+                self.dtype, keep_cols=plan.keep_cols_np)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = lambda a: jax.device_put(a, NamedSharding(
+                    self.mesh, PartitionSpec(*([None] * a.ndim))))
+                l2, u2, lb2, ub2, c2 = (repl(l2), repl(u2), repl(lb2),
+                                        repl(ub2), repl(c2))
+            plan.data_c = plan.data_c._replace(l=l2, u=u2,
+                                               lb=lb2, ub=ub2)
+            plan.c_c = c2
+        # capture surviving warm iterates BEFORE the invalidation
+        # drops them (cross-bucket warm transplant; pulled back by the
+        # first state build of the new bucket)
+        self._transplant_capture(plan)
         self._shrink = plan
         self._compact_invalidate()
         obs.counter_add("shrink.compactions")
@@ -953,7 +1027,10 @@ class PHBase(SPBase):
         compacted representation — (A, P, rho) of the full system are
         untouched, and the full=True / fixed-mode consumers (dive,
         cross-scenario, incumbent eval) would otherwise pay a full
-        re-factorization per transition for nothing."""
+        re-factorization per transition for nothing. The transplant
+        snapshot (``_transplant_src``, taken by maybe_compact just
+        before this runs) deliberately survives — it IS the warm state
+        the next bucket's first state build pulls back."""
         self._shrink_factors.clear()
         self._qp_states.clear()
         self._kernel_plans.clear()
@@ -965,6 +1042,193 @@ class PHBase(SPBase):
         getattr(self, "_chunk_idx_cache", {}).clear()
         self._pool_states.clear()
         self._pool_dirty.clear()
+
+    # ---- cross-bucket warm transplant (ops/shrink) ----
+    def _transplant_book_cold(self, reason):
+        obs.counter_add("shrink.transplant_cold_fallbacks")
+        if self._shrink_status is not None:
+            self._shrink_status["transplant_cold"] += 1
+        obs.event("shrink.transplant_cold",
+                  {"iter": self._iter, "reason": reason})
+
+    def _transplant_fields(self, mode):
+        """One mode's warm ADMM iterates as full-(S, ·) device arrays,
+        or None when the mode has nothing usable cached. Prefers the
+        authoritative per-chunk states (concatenated; sharded chunks
+        via the mesh's local concat, host chunks with their tail pads
+        trimmed), falls back to a genuine full-width QPState (the
+        dispatch store doubles as one). _ChunkStateView alone is never
+        read: its precomputed x is the EXPANDED unscaled solution
+        while its iterates are compacted — not solver state."""
+        S = self.batch.S
+        fields = ("x", "yA", "yB", "zA", "zB")
+        chunk_states = self._qp_states.get(("chunks", mode))
+        if chunk_states:
+            if self._shard_ops is not None:
+                return {f: self._shard_ops.from_chunks(
+                            [getattr(s, f) for s in chunk_states])
+                        for f in fields}
+            cw = chunk_states[0].x.shape[0]
+            trims = [r for _, r in self._chunk_index(cw)]
+            return {f: jnp.concatenate(
+                        [getattr(s, f)[:r]
+                         for s, r in zip(chunk_states, trims)])
+                    for f in fields}
+        st = self._qp_states.get(mode)
+        if isinstance(st, QPState) and st.x.shape[0] == S:
+            return {f: getattr(st, f) for f in fields}
+        return None
+
+    def _transplant_capture(self, plan_new):
+        """Snapshot each hot-loop mode's surviving warm iterates at a
+        bucket transition, keyed to the NEW plan's fingerprint — the
+        invalidation about to run drops every cached state, and
+        without this the near-converged problem restarts cold each
+        transition. Stores ONLY the iterate arrays plus the old
+        factors' scaling vectors (D/E/Eb/cost_scale) and the old
+        plan's geometry — never whole factors, which would pin the old
+        compacted split pair in HBM across the transition. Scenarios
+        the hospital declared incurable (their cached iterates carry
+        stale loose solves) are masked out and restart cold."""
+        self._transplant_src = None
+        if not bool(self.options.get("shrink_transplant", True)):
+            return
+        S = self.batch.S
+        old = self._shrink
+        modes = {}
+        for mode in (True, False):
+            if mode in self._chunk_dirty:
+                # a donating pass died mid-flight: the cached iterates
+                # reference deleted buffers
+                self._transplant_book_cold("dirty donated pass")
+                continue
+            fields = self._transplant_fields(mode)
+            if fields is None:
+                continue       # mode never ran — nothing to carry
+            ent = None
+            if old is not None:
+                ent = self._shrink_factors.get(mode) \
+                    or next(iter(self._shrink_factors.values()), None)
+            else:
+                ent = self._factors.get(mode)
+            if ent is None:
+                self._transplant_book_cold("no source factors")
+                continue
+            fac = ent[0]
+            ok = np.ones(S, bool)
+            for g in self._hospital_no_retry.get(mode, ()):
+                if g < S:
+                    ok[g] = False
+            # the state must certify itself: a converged ADMM state has
+            # x ≈ zB (box-split consensus) AND A·x ≈ zA (row-split
+            # consensus); a diverged row fails at least one. The
+            # state's OWN pri_rel rows cannot be trusted here — the
+            # hospital scatters its good residual rows back while the
+            # device iterates stay diverged (see _hospitalize), so a
+            # hospital-frequent scenario reads converged while carrying
+            # garbage. Unscale with the donor factors and gate both
+            # consensus gaps (one raw matvec per mode per transition).
+            from ..ops.qp_solver import _Ax
+
+            def _b2(v):
+                # scaling vectors: shared (·,) or per-scenario (S, ·)
+                a = np.asarray(v)   # lint: ok[SYNC001] once-per-transition capture gate, outside the chunk chain (the transition refactorizes anyway)
+                return a if a.ndim == 2 else a[None, :]
+
+            x_u = np.asarray(fields["x"]) * _b2(fac.D)      # lint: ok[SYNC001] once-per-transition capture gate
+            zB_u = np.asarray(fields["zB"]) / _b2(fac.Eb)   # lint: ok[SYNC001] once-per-transition capture gate
+            zA_u = np.asarray(fields["zA"]) / _b2(fac.E)    # lint: ok[SYNC001] once-per-transition capture gate
+            ax_u = np.asarray(_Ax(ent[1].A, jnp.asarray(x_u)))  # lint: ok[SYNC001] once-per-transition capture gate (the one raw matvec per mode)
+            gap_b = np.abs(x_u - zB_u).max(axis=1)
+            gap_a = np.abs(ax_u - zA_u).max(axis=1)
+            scale = np.maximum.reduce(
+                [np.ones(S), np.abs(x_u).max(axis=1),
+                 np.abs(ax_u).max(axis=1)])
+            gate = max(100 * _hot_eps(bool(mode), self.sub_eps,  # lint: ok[SYNC001] mode is a host bool (the factor-cache key), not a device value
+                                      self.sub_eps_hot), 1e-2)
+            gap = np.maximum(gap_b, gap_a)
+            ok &= np.isfinite(gap) & (gap / scale <= gate)
+            modes[mode] = {
+                "st": fields,
+                "fac": {"D": fac.D, "E": fac.E, "Eb": fac.Eb,
+                        "cs": fac.cost_scale},
+                "keep_cols": None if old is None else old.keep_cols_np,
+                "keep_rows": None if old is None else old.keep_rows_np,
+                "shift": None if old is None else old.rhs_shift,
+                "ok": ok}
+        if modes:
+            self._transplant_src = {
+                "fingerprint": plan_new.fingerprint, "modes": modes}
+
+    def _transplant_pull(self, key, factors_new):
+        """Rescale the captured warm iterates into the CURRENT plan's
+        compacted geometry (ops/shrink._transplant_rescale), or None
+        when no applicable snapshot exists. Books
+        ``shrink.transplant_cold_fallbacks`` only when a snapshot for
+        this plan EXISTS but a guard rejects it — a silent None (no
+        snapshot, different bucket, fixed-mode key) is not a fallback,
+        it is the ordinary cold build."""
+        src = getattr(self, "_transplant_src", None)
+        plan = self._shrink
+        if src is None or plan is None \
+                or src["fingerprint"] != plan.fingerprint \
+                or not isinstance(key, bool):
+            return None
+        mode = key if key in src["modes"] else \
+            next(iter(src["modes"]), None)
+        ent = src["modes"].get(mode)
+        if ent is None:
+            return None
+        new_keep, new_rows = plan.keep_cols_np, plan.keep_rows_np
+        old_keep = ent["keep_cols"]
+        if old_keep is None:
+            old_keep = np.arange(plan.n_full)
+        old_rows = ent["keep_rows"]
+        if old_rows is None:
+            old_rows = np.arange(plan.m_full)
+        st = ent["st"]
+        # direction-aware width guard: buckets only ever FIX more
+        # slots, so the new kept set must nest inside the old one —
+        # anything else (re-admitted slots, a rebuilt batch) is not a
+        # gather and restarts cold
+        if st["x"].shape[-1] != old_keep.size \
+                or st["zA"].shape[-1] != old_rows.size \
+                or new_keep.size > old_keep.size \
+                or new_rows.size > old_rows.size:
+            self._transplant_book_cold("width mismatch")
+            return None
+        if not (np.isin(new_keep, old_keep).all()
+                and np.isin(new_rows, old_rows).all()):
+            self._transplant_book_cold("active set not nested")
+            return None
+        pos_c = jnp.asarray(
+            np.searchsorted(old_keep, new_keep).astype(np.int32))
+        pos_r = jnp.asarray(
+            np.searchsorted(old_rows, new_rows).astype(np.int32))
+        shift_old = ent["shift"]
+        if shift_old is None:
+            # full-width source: the full system has no rhs fold —
+            # a (1, m_full) zero row broadcasts over scenarios
+            shift_old = jnp.zeros((1, int(plan.m_full)),
+                                  plan.rhs_shift.dtype)
+        fac_o = ent["fac"]
+        cs_ratio = factors_new.cost_scale / fac_o["cs"]
+        from ..ops.shrink import _transplant_rescale
+        x_n, yA_n, yB_n, zA_n, zB_n = _transplant_rescale(
+            st["x"], st["yA"], st["yB"], st["zA"], st["zB"],
+            pos_c, pos_r, fac_o["D"], factors_new.D,
+            fac_o["E"], factors_new.E, fac_o["Eb"], factors_new.Eb,
+            cs_ratio, shift_old, plan.rhs_shift,
+            jnp.asarray(ent["ok"]))
+        obs.counter_add("shrink.transplants")
+        if self._shrink_status is not None:
+            self._shrink_status["transplants"] += 1
+        obs.event("shrink.transplant", {
+            "iter": self._iter, "mode": _mode_str(mode),
+            "bucket": plan.bucket, "n_cols": plan.n_c,
+            "cold_rows": int((~ent["ok"]).sum())})
+        return {"x": x_n, "yA": yA_n, "yB": yB_n,
+                "zA": zA_n, "zB": zB_n}
 
     def _ensure_state(self, prox_on=True, fixed=False):
         """Per-mode solver state (the KKT factor depends on the prox term);
@@ -985,9 +1249,16 @@ class PHBase(SPBase):
                     x=st.x, yA=st.yA, yB=st.yB, zA=st.zA, zB=st.zB)
             else:
                 # a shrink-era view's precomputed x is EXPANDED while
-                # its iterates are compacted — width mismatch means
-                # the warm start is not transplantable; start cold
-                st = cold
+                # its iterates are compacted — same-era widths are not
+                # transplantable; a cross-BUCKET snapshot may still be
+                # (the warm transplant), else start cold
+                tp = self._transplant_pull(key, factors)
+                if tp is not None \
+                        and tp["x"].shape == cold.x.shape \
+                        and tp["zA"].shape == cold.zA.shape:
+                    st = cold._replace(**tp)
+                else:
+                    st = cold
             self._qp_states[key] = st
             return st
         if key not in self._qp_states:
@@ -1003,6 +1274,14 @@ class PHBase(SPBase):
                 # (buffers are never donated — sharing them is safe)
                 st = st._replace(x=other.x, yA=other.yA, yB=other.yB,
                                  zA=other.zA, zB=other.zB)
+            else:
+                # no same-width sibling: a captured cross-bucket
+                # snapshot (maybe_compact -> _transplant_capture) warm
+                # starts the new compacted geometry instead of cold
+                tp = self._transplant_pull(key, factors)
+                if tp is not None and tp["x"].shape == st.x.shape \
+                        and tp["zA"].shape == st.zA.shape:
+                    st = st._replace(**tp)
             self._qp_states[key] = st
         return self._qp_states[key]
 
@@ -1094,18 +1373,36 @@ class PHBase(SPBase):
             #   view's precomputed x is EXPANDED to full width while
             #   its solver states are compacted — full iterates must
             #   never transplant into a compacted cold state)
+            tp = None
+            if not transplant:
+                # no same-width sibling mode: try the cross-bucket
+                # warm transplant (the snapshot maybe_compact captured
+                # before invalidating) — post-transition re-convergence
+                # from warm iterates instead of cold zeros
+                tp = self._transplant_pull(key, factors)
+                if tp is not None and (
+                        tp["x"].shape[-1] != st0.x.shape[-1]
+                        or tp["zA"].shape[-1] != st0.zA.shape[-1]):
+                    tp = None
             if transplant and chunks is not None:
                 oth_ch = self._shard_ops.to_chunks(
                     {"x": other.x, "yA": other.yA, "yB": other.yB,
                      "zA": other.zA, "zB": other.zB}, lc)
+            elif tp is not None and chunks is not None:
+                oth_ch = self._shard_ops.to_chunks(tp, lc)
             for ci, (idx, _) in enumerate(slices):
                 st = st0
-                if transplant:
+                if transplant or tp is not None:
                     if oth_ch is not None:
                         st = st._replace(
                             x=oth_ch["x"][ci], yA=oth_ch["yA"][ci],
                             yB=oth_ch["yB"][ci], zA=oth_ch["zA"][ci],
                             zB=oth_ch["zB"][ci])
+                    elif tp is not None:
+                        st = st._replace(
+                            x=tp["x"][idx], yA=tp["yA"][idx],
+                            yB=tp["yB"][idx], zA=tp["zA"][idx],
+                            zB=tp["zB"][idx])
                     else:
                         st = st._replace(
                             x=other.x[idx], yA=other.yA[idx],
@@ -1218,6 +1515,22 @@ class PHBase(SPBase):
                         "fv": self._fixed_vals}
             if self._w_scale is not None:
                 per_scen["ws"] = self._w_scale
+            if shrink is not None:
+                # compacted streamed pass: assemble-side hub blocks
+                # gather to the free slots (the source ships compacted
+                # l/u/lb/ub and FULL-width c); pass 3 keeps the full
+                # W plus the fold constants for the expanded
+                # objectives / compacted dual bound
+                fs = shrink.free_slots_dev
+                per_scen.update(
+                    {"W": self.W[:, fs], "xbar": self.xbar[:, fs],
+                     "rho": self.rho[:, fs],
+                     "fm": self._fixed_mask[:, fs],
+                     "fv": self._fixed_vals[:, fs],
+                     "WF": self.W, "c0fold": c0fold,
+                     "fvcols": shrink.fixed_colvals})
+                if self._w_scale is not None:
+                    per_scen["ws"] = self._w_scale[:, fs]
             return self._shard_ops.to_chunks(per_scen, lc)
         per_scen = {"l": data.l, "u": data.u, "lb": data.lb,
                     "ub": data.ub, "c0": self.c0, "P0": self.P_diag}
@@ -1380,15 +1693,26 @@ class PHBase(SPBase):
                     getattr(self, "_dispatch_bind_seq", 0) + 1
                 lkey = ("dispatch", chunk, self.batch.S,
                         self._dispatch_bind_seq)
+                if shrink is not None:
+                    lkey = lkey + ("compact", shrink.fingerprint)
                 stream.bind(lkey, [ids_pad[i * chunk:(i + 1) * chunk]
-                                   for i in range(n_dchunks)])
+                                   for i in range(n_dchunks)],
+                            compacted=shrink is not None)
             else:
                 lkey = (("sharded", lc, self.batch.S) if sharded
                         else ("host", chunk, self.batch.S))
+                if shrink is not None:
+                    # the store WIDTH is part of the layout: a bucket
+                    # transition (new fingerprint) must re-bind even
+                    # when the chunk geometry is unchanged, and a
+                    # fixed-mode full-width pass must never share a
+                    # compacted bind
+                    lkey = lkey + ("compact", shrink.fingerprint)
                 if stream.bound_key != lkey:
                     # lint: ok[SYNC001] layout staging once per chunk-layout change (guarded by bound_key above), never per iteration
                     arrs = [np.asarray(idx) for idx, _ in slices]
-                    stream.bind(lkey, arrs)
+                    stream.bind(lkey, arrs,
+                                compacted=shrink is not None)
         self._drop_if_dirty(key)
         if dispatch is not None:
             # full-width per-scenario warm store: per-chunk positional
@@ -1542,10 +1866,19 @@ class PHBase(SPBase):
                                     a_rho[idx_c])
                 fm_c, fv_c = a_fm[idx_c], a_fv[idx_c]
                 ws = None if a_ws is None else a_ws[idx_c]
+            # under an active shrink plan the source stages compacted
+            # l/u/lb/ub but keeps c FULL width (install_compacted):
+            # assembly gathers the kept columns — a pure gather, so
+            # the compacted q is bit-equal to the resident plan.c_c
+            # spelling — while the returned full c serves pass 3's
+            # expanded objectives
+            c_blk = blk["c"]
+            c_asm = c_blk[:, shrink.keep_cols] if shrink is not None \
+                else c_blk
             q_c, bl_c, bu_c = _ph_assemble(
-                d_c, blk["c"], W_c, xb_c, rho_c, idx_asm, fm_c, fv_c,
+                d_c, c_asm, W_c, xb_c, rho_c, idx_asm, fm_c, fv_c,
                 ws, w_on=bool(w_on), prox_on=bool(prox_on))
-            return d_c._replace(lb=bl_c, ub=bu_c), q_c, blk["c"]
+            return d_c._replace(lb=bl_c, ub=bu_c), q_c, c_blk
 
         # ASSEMBLE — pipelined: enqueue every chunk's assembly now
         # (async dispatch); the device interleaves this elementwise work
@@ -1862,7 +2195,26 @@ class PHBase(SPBase):
                 # objectives against the FULL cost structures; the
                 # dual bound stays on the compacted system + fold
                 from ..ops.shrink import expand_solution
-                if sharded:
+                if stream is not None:
+                    # restage this chunk (the second in-order pipeline
+                    # pass begun above): the records dropped the data
+                    # blocks, and the reassembled compacted (d, q) are
+                    # bit-identical to pass 1's for the dual bound;
+                    # the full-width c chunk rides along for the
+                    # expanded objectives, and the RAW shared P row
+                    # broadcasts (the objective must not carry the
+                    # prox rho)
+                    d_h, q_h, cF_c = _stream_assemble(ci)
+                    P0_c = jnp.broadcast_to(self.qp_data.P_diag,
+                                            cF_c.shape)
+                    if sharded:
+                        fvc, WF_c = chs["fvcols"][ci], chs["WF"][ci]
+                        c0_c, c0f_c = chs["c0"][ci], chs["c0fold"][ci]
+                    else:
+                        fvc = shrink.fixed_colvals[idx_c]
+                        WF_c = self.W[idx_c]
+                        c0_c, c0f_c = self.c0[idx_c], c0fold[idx_c]
+                elif sharded:
                     fvc, cF_c, WF_c = (chs["fvcols"][ci], chs["cF"][ci],
                                        chs["WF"][ci])
                     c0_c, P0_c = chs["c0"][ci], chs["P0"][ci]
@@ -2112,12 +2464,25 @@ class PHBase(SPBase):
                             "stream.synth_chunks",
                             "stream.prefetch_stalls",
                             "stream.direct_fetches",
+                            # shrink x stream composition: transitions
+                            # re-block the host store and restage once
+                            # out-of-band — analyze's flatness verdict
+                            # excludes these bytes from bytes_shipped
+                            "stream.compacted_transitions",
+                            "stream.compacted_restage_bytes",
                             # progressive shrinking (ops/shrink): newly
                             # fixed slots and bucket transitions THIS
                             # iteration — analyze's shrinking section
                             # reads these off the record stream
                             "shrink.fixed_new",
-                            "shrink.compactions")
+                            "shrink.compactions",
+                            # cross-bucket warm transplant: warm-state
+                            # pulls vs guarded cold restarts at each
+                            # transition — the analyze re-convergence
+                            # row and its --compare REGRESSION read
+                            # these
+                            "shrink.transplants",
+                            "shrink.transplant_cold_fallbacks")
 
     def iteration_record(self, it, seconds, phase_before, counters_before):
         """The structured per-iteration convergence record (the
@@ -2250,7 +2615,13 @@ class PHBase(SPBase):
             # operands, so the rescue solves THE SAME system the chunk
             # solves do and its rows scatter back width-consistent
             fs = shrink.free_slots_dev
-            c_sel = shrink.c_c[sel_p]
+            if stream is not None:
+                # rb["c"] above is FULL width (the compacted store
+                # keeps c full; plan.c_c is a 2-row setup surrogate
+                # under streaming) — gather the kept columns
+                c_sel = c_sel[:, shrink.keep_cols]
+            else:
+                c_sel = shrink.c_c[sel_p]
             W_s, xb_s, rho_s = (self.W[sel_p][:, fs],
                                 self.xbar[sel_p][:, fs],
                                 self.rho[sel_p][:, fs])
@@ -3146,13 +3517,20 @@ class PH(PHBase):
                 return self.conv
 
         # Iter k loop (ref. phbase.py:1472 iterk_loop)
+        pt0 = ctr0 = None
         for it in range(1, self.max_iterations + 1):
             self._iter = it
             rec_on = obs.enabled()
-            if rec_on:
+            if rec_on and ctr0 is None:
                 # snapshots for the per-iteration convergence record:
                 # phase wall-clock totals and the recovery/compile
-                # counters, diffed after the solve
+                # counters, diffed after the solve. Only the FIRST
+                # window opens here — later windows open at the
+                # previous record's close below, so counters booked by
+                # miditer extensions (device fixing, a compaction
+                # transition's restage) land in the next iteration's
+                # deltas instead of a bookkeeping gap between the
+                # record and the next top-of-loop snapshot.
                 pt0 = self._phase_totals()
                 ctr0 = obs.counters_snapshot()
             t_it = _time.perf_counter()
@@ -3165,6 +3543,8 @@ class PH(PHBase):
                 obs.histogram_observe("ph.iteration_seconds", t_end - t_it)
                 obs.event("ph.iteration", self.iteration_record(
                     it, t_end - t_it, pt0, ctr0))
+                pt0 = self._phase_totals()
+                ctr0 = obs.counters_snapshot()
                 # device memory watermark gauges (guarded no-op on
                 # backends without allocator stats, e.g. CPU)
                 _obs_resource.sample_memory()

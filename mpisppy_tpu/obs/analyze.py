@@ -361,6 +361,7 @@ def shrink_summary(run: Run) -> dict | None:
                 tot[k] = tot.get(k, 0) + v
     compactions = run.of("shrink.compaction")
     fixes = run.of("shrink.fix")
+    transplants = run.of("shrink.transplant")
     rows = [e for e in iteration_rows(run) if e.get("shrink")]
     if not tot and not compactions and not fixes and not rows:
         return None
@@ -386,6 +387,40 @@ def shrink_summary(run: Run) -> dict | None:
               if (t.get("bucket") or 0.0) == b
               and t.get("est_hbm_bytes_per_iter") is not None), None)}
         for b, v in sorted(per_bucket.items())]
+    # per-bucket post-transition re-convergence (ISSUE 17): a bucket
+    # transition rebuilds the per-scenario ADMM states — warm (the
+    # cross-bucket transplant pulled the old bucket's iterates) or
+    # cold (a guard booked shrink.transplant_cold_fallbacks). The
+    # recovery cost is measured in PH iterations: conv at the
+    # transition iteration is the pre level (the compaction lands in
+    # that iteration's miditer, so its record still reflects the old
+    # system), and recovery is the first later iteration whose conv is
+    # back at or under it. Warm should recover in strictly fewer
+    # iterations — the --compare cold-fallback verdict reads the
+    # counter, this table shows the price actually paid.
+    all_rows = [e for e in iteration_rows(run)
+                if isinstance(e.get("conv"), (int, float))]
+    warm_buckets = {e.get("bucket") for e in transplants}
+    reconvergence = []
+    for ev in compactions:
+        t = ev.get("iter")
+        if t is None:
+            continue
+        pre = next((e["conv"] for e in reversed(all_rows)
+                    if e["iter"] <= t), None)
+        recovered = None
+        if pre is not None:
+            recovered = next((e["iter"] for e in all_rows
+                              if e["iter"] > t and e["conv"] <= pre),
+                             None)
+        reconvergence.append({
+            "bucket": ev.get("bucket"), "iter": t,
+            "mode": ("warm" if ev.get("bucket") in warm_buckets
+                     else "cold"),
+            "pre_conv": pre,
+            "recovered_iter": recovered,
+            "iters_to_reconverge":
+                (recovered - t) if recovered is not None else None})
     return {
         "fixed_final": (traj[-1]["fixed"] if traj else None),
         "free_final": (traj[-1]["free"] if traj else None),
@@ -397,6 +432,10 @@ def shrink_summary(run: Run) -> dict | None:
         "rho_updates": int(tot.get("shrink.rho_updates", 0)),
         "bucket_compiles": int(tot.get("shrink.bucket.compile", 0)),
         "bucket_cache_hits": int(tot.get("shrink.bucket.cache_hit", 0)),
+        "transplants": int(tot.get("shrink.transplants", 0)),
+        "transplant_cold_fallbacks":
+            int(tot.get("shrink.transplant_cold_fallbacks", 0)),
+        "reconvergence": reconvergence,
         "compaction_events": [
             {"iter": e.get("iter"), "bucket": e.get("bucket"),
              "n_cols": e.get("n_cols"), "m_rows": e.get("m_rows"),
@@ -440,9 +479,21 @@ def streaming_summary(run: Run) -> dict | None:
          "bytes_shipped":
              e.get("counter_deltas", {}).get("stream.bytes_shipped", 0),
          "synth_chunks":
-             e.get("counter_deltas", {}).get("stream.synth_chunks", 0)}
+             e.get("counter_deltas", {}).get("stream.synth_chunks", 0),
+         "compacted_transitions":
+             e.get("counter_deltas", {}).get(
+                 "stream.compacted_transitions", 0)}
         for e in iteration_rows(run)]
-    steady = [r["device_put_bytes"] for r in per_iter[1:]]
+    # steady state starts after the LAST compacted re-block (ISSUE 17
+    # shrink×stream): a transition legitimately changes the shipped
+    # width (and pays its one out-of-band restage), so flatness is
+    # judged on the iterations solving the final layout — otherwise
+    # every compacted streamed wheel would read as a leak
+    start = 1
+    for i, r_ in enumerate(per_iter):
+        if r_["compacted_transitions"]:
+            start = max(start, i + 1)
+    steady = [r["device_put_bytes"] for r in per_iter[start:]]
     return {
         "source": source,
         "chunks_shipped": chunks,
@@ -450,6 +501,10 @@ def streaming_summary(run: Run) -> dict | None:
         "synth_chunks": synth,
         "direct_fetches": int(tot.get("stream.direct_fetches", 0)),
         "int8_fallbacks": int(tot.get("stream.int8_fallbacks", 0)),
+        "compacted_transitions":
+            int(tot.get("stream.compacted_transitions", 0)),
+        "compacted_restage_bytes":
+            int(tot.get("stream.compacted_restage_bytes", 0)),
         "prefetch_stalls": stalls,
         # fraction of staged chunks the prefetcher had ready before the
         # consumer asked — 1.0 means the H2D fully hid under compute
@@ -1082,6 +1137,20 @@ def render_report(run: Run) -> str:
                          f"{e['m_rows']}/{e['m_full']} rows"
                          + (" [cached]" if e.get("bucket_cached")
                             else ""))
+        if shr["transplants"] or shr["transplant_cold_fallbacks"]:
+            L.append(f"cross-bucket transplants {shr['transplants']}  "
+                     "cold fallbacks "
+                     f"{shr['transplant_cold_fallbacks']}")
+        if shr["reconvergence"]:
+            L.append("post-transition re-convergence "
+                     "(iterations back to the pre-transition conv):")
+            for r in shr["reconvergence"]:
+                k = r["iters_to_reconverge"]
+                L.append(
+                    f"  bucket {r['bucket']:g} (iter {r['iter']}, "
+                    f"{r['mode']}): "
+                    + (f"{k} iter(s)" if k is not None else
+                       "not recovered in the record"))
         if shr["per_bucket"]:
             L.append("per-bucket s/iter (active-set verdict source):")
             for b in shr["per_bucket"]:
@@ -1111,6 +1180,12 @@ def render_report(run: Run) -> str:
                  + (f"  occupancy {_fmt(occ, 3)}" if occ is not None
                     else "")
                  + f"  int8 fallbacks {stm['int8_fallbacks']}")
+        if stm["compacted_transitions"]:
+            L.append(f"compacted re-blocks "
+                     f"{stm['compacted_transitions']}  (out-of-band "
+                     f"restage "
+                     f"{_fmt_b(stm['compacted_restage_bytes'])}; "
+                     "steady state judged after the last transition)")
         flat = stm["device_put_flat_steady_state"]
         if flat is not None:
             L.append("steady-state device_put: "
@@ -1424,8 +1499,13 @@ def compare(a: Run, b: Run, threshold=1.5,
     # per-bucket s/iter as one explicit line. A side whose
     # last-bucket mean runs >1.5x its bucket-0 mean (over the abs
     # floor) broke the promise and books a regression.
+    sha = shb = None
     for tag, run_ in (("A", a), ("B", b)):
         sh = shrink_summary(run_)
+        if tag == "A":
+            sha = sh
+        else:
+            shb = sh
         if sh is None or not sh.get("per_bucket"):
             continue
         pb = sh["per_bucket"]
@@ -1444,6 +1524,33 @@ def compare(a: Run, b: Run, threshold=1.5,
             line += (f" — active-set verdict [{verdict}] "
                      f"(compactions {sh['compactions']})")
         L.append(f"  shrink[{tag}]: {line}")
+    # transplant verdict row (ISSUE 17, doc/extensions.md §shrinking):
+    # at an EQUAL bucket schedule (the same compaction sequence ran on
+    # both sides), the cross-bucket transplant promise is that B's
+    # guarded cold restarts did not grow — a grown count means warm
+    # states stopped surviving the transition (width-mismatch, dirty
+    # donated passes, lost source factors: exactly the silent decay
+    # the counter exists to catch). Different schedules are a config
+    # change, not a regression; the row says so and abstains.
+    if sha is not None and shb is not None:
+        sched_a = [e.get("bucket") for e in sha["compaction_events"]]
+        sched_b = [e.get("bucket") for e in shb["compaction_events"]]
+        ca = sha["transplant_cold_fallbacks"]
+        cb = shb["transplant_cold_fallbacks"]
+        if sched_a and sched_a != sched_b:
+            L.append(f"  transplant: bucket schedule differs "
+                     f"(A={sched_a} B={sched_b}) — cold-fallback "
+                     "verdict [skipped]")
+        elif sched_a and (sha["transplants"] or ca
+                          or shb["transplants"] or cb):
+            verdict = "PASS"
+            if cb > ca:
+                verdict = "REGRESSION"
+                regressions.append("shrink_transplant_cold_fallbacks")
+            L.append(
+                f"  transplant: warm A={sha['transplants']} "
+                f"B={shb['transplants']}  cold A={ca} B={cb} — "
+                f"cold-fallback verdict [{verdict}]")
     only = [k[0] for k in (set(ma) ^ set(mb))]
     if only:
         L.append(f"  (not in both runs, skipped: {sorted(only)})")

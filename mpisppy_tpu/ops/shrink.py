@@ -46,7 +46,7 @@ from ..ckpt.bundle import config_fingerprint
 from ..utils.config import parse_shrink_buckets as parse_buckets  # noqa: F401
 #   (re-exported: the jax-free parser lives in utils/config so CLI/serve
 #   validation never imports this jax-touching module)
-from .qp_solver import QPData
+from .qp_solver import QPData, ScaledView, SplitMatrix
 
 # "never fix" threshold sentinel: must survive an int32 cast (x64-off
 # environments) — 2^30 consecutive converged iterations is never
@@ -137,6 +137,17 @@ class ShrinkPlan:
     data_c: QPData = None         # compacted problem data
     c_c: jax.Array = None         # (S, n_c) compacted linear cost
     c0_fold: jax.Array = None     # (S,) c0 + fixed-var cost contributions
+    rhs_shift: jax.Array = None   # (S, m_c) folded rhs shift (l/u moved
+    #                               by -shift; transplant re-centers row
+    #                               slacks through it)
+    keep_rows_np: np.ndarray = None   # (m_c,) host row ids kept
+    keep_cols_np: np.ndarray = None   # (n_c,) host column ids kept
+    fac_base: object = None       # df32: first-mode QPFactors — pinned
+    #                               here because data_c.A becomes the
+    #                               ScaledView after that build, so
+    #                               later rebuilds need this base's
+    #                               equilibration (core/ph
+    #                               _shrink_get_factors)
     meta: dict = field(default_factory=dict)
 
 
@@ -160,6 +171,19 @@ def _fold_compact(A, l, u, lb, ub, P_diag, c, c0, keep_rows, keep_cols,
         A_c = A_keep[..., keep_cols]
         A_f = A_keep[..., fixed_cols]          # (S, m_c, n_f)
         shift = jnp.einsum("smf,sf->sm", A_f, fv)
+    l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold = _fold_vectors(
+        l, u, lb, ub, P_diag, c, c0, keep_rows, keep_cols, fixed_cols,
+        fv, shift)
+    return A_c, l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold, shift
+
+
+@jax.jit
+def _fold_vectors(l, u, lb, ub, P_diag, c, c0, keep_rows, keep_cols,
+                  fixed_cols, fv, shift):
+    """The vector half of :func:`_fold_compact` with the rhs shift
+    supplied externally — the df32 paths compute the shift from the
+    split/scaled fixed-column block (see ``_split_fixed_shift``) and
+    share these folds with the dense path bit-for-bit."""
     l_c = l[:, keep_rows] - shift
     u_c = u[:, keep_rows] - shift
     lb_c = lb[:, keep_cols]
@@ -168,7 +192,39 @@ def _fold_compact(A, l, u, lb, ub, P_diag, c, c0, keep_rows, keep_cols,
     c_c = c[:, keep_cols]
     c0_fold = c0 + jnp.sum(c[:, fixed_cols] * fv, axis=1) \
         + 0.5 * jnp.sum(P_diag[..., fixed_cols] * fv * fv, axis=-1)
-    return A_c, l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold
+    return l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold
+
+
+@jax.jit
+def _split_fixed_shift(hi_f, lo_f, inv_e, inv_d_f, fv):
+    """rhs shift of the folded columns from a df32 fixed-column block:
+    the f64 value of the (already row/col-gathered) split block,
+    unscaled by ``inv_e``/``inv_d_f`` (ones for a raw SplitMatrix;
+    1/E / 1/D for a ScaledView), contracted with the folded values.
+    The block is (m_c, n_f) — small next to A — so one f64
+    materialization per bucket transition is fine."""
+    A_f = (hi_f.astype(jnp.float64) + lo_f.astype(jnp.float64)) \
+        * inv_e[:, None] * inv_d_f[None, :]
+    return fv @ A_f.T
+
+
+@partial(jax.jit, static_argnames=("nblocks",))
+def _unscale_split_blocks(hi, lo, inv_e, inv_d, nblocks=8):
+    """Unscale an (already gathered) compacted ScaledView block back to
+    a raw df32 pair: blk = (hi+lo)·(1/E)·(1/D) re-split, in ROW BLOCKS
+    so the f64 transient exists one block at a time (the
+    _scale_split_blocks discipline in reverse)."""
+    m = hi.shape[0]
+    his, los = [], []
+    bounds = [(m * i) // nblocks for i in range(nblocks + 1)]
+    for i in range(nblocks):
+        sl = slice(bounds[i], bounds[i + 1])
+        blk = (hi[sl].astype(jnp.float64) + lo[sl].astype(jnp.float64)) \
+            * inv_e[sl, None] * inv_d[None, :]
+        h = blk.astype(jnp.float32)
+        los.append((blk - h.astype(jnp.float64)).astype(jnp.float32))
+        his.append(h)
+    return jnp.concatenate(his), jnp.concatenate(los)
 
 
 @partial(jax.jit, static_argnames=("w_on", "prox_on"))
@@ -247,15 +303,22 @@ def build_plan(qp_data: QPData, c, c0, nonant_idx, fixed_mask,
     free_slots = np.flatnonzero(~slot_fixed)
     if fixed_slots.size == 0 or free_slots.size == 0:
         return None
-    n = int(qp_data.A.shape[-1])
-    m = int(qp_data.A.shape[-2])
+    A = qp_data.A
+    n = int(A.shape[-1])
+    m = int(A.shape[-2])
     fixed_cols = np.sort(idx_np[fixed_slots])
     keep_cols = np.setdiff1d(np.arange(n), fixed_cols)
     # rows that still touch a kept column IN ANY SCENARIO; rows whose
     # every nonzero is a fixed column reduce to constants and are
-    # dropped with them
+    # dropped with them. df32 representations read the pattern off the
+    # split pair (a ScaledView's A_s shares A's zero pattern — Ruiz
+    # scalings are diagonal and positive)
     keep_dev = jnp.asarray(keep_cols)
-    touched = qp_data.A[..., keep_dev] != 0
+    pat = A.A_s if isinstance(A, ScaledView) else A
+    if isinstance(pat, SplitMatrix):
+        touched = (pat.hi[:, keep_dev] != 0) | (pat.lo[:, keep_dev] != 0)
+    else:
+        touched = pat[..., keep_dev] != 0
     row_touch = np.asarray(
         jnp.any(touched, axis=(0, 2) if touched.ndim == 3 else 1))
     keep_rows = np.flatnonzero(row_touch)                # (m,) one D2H
@@ -266,9 +329,47 @@ def build_plan(qp_data: QPData, c, c0, nonant_idx, fixed_mask,
     # folded values per ORIGINAL column order (nonant slots -> columns)
     order = np.argsort(idx_np[fixed_slots])
     fv = jnp.asarray(fixed_vals, dtype)[:, jnp.asarray(fixed_slots[order])]
-    A_c, l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold = _fold_compact(
-        qp_data.A, qp_data.l, qp_data.u, qp_data.lb, qp_data.ub,
-        qp_data.P_diag, c, c0, keep_rows_d, keep_dev, fixed_cols_d, fv)
+    if isinstance(A, (SplitMatrix, ScaledView)):
+        # df32 compacted gather: exact hi/lo row/column gathers of the
+        # split pair; a ScaledView gathers the SCALED pair and unscales
+        # blockwise back to a raw split (the compacted system gets its
+        # own Ruiz pass in _shrink_get_factors, so plans carry the raw
+        # representation either way). Packed layouts are screened out
+        # by the engine guard (core/ph.maybe_compact) before this.
+        if isinstance(A, ScaledView):
+            if isinstance(A.A_s, SplitMatrix):
+                hi, lo = A.A_s.hi, A.A_s.lo
+            else:       # dense scaled matrix: two-term split, exact
+                hi = A.A_s.astype(jnp.float32)
+                lo = (A.A_s - hi.astype(jnp.float64)) \
+                    .astype(jnp.float32)
+            inv_e = 1.0 / A.E
+            inv_d = 1.0 / A.D
+        else:
+            hi, lo = A.hi, A.lo
+            inv_e = jnp.ones((m,), jnp.float64)
+            inv_d = jnp.ones((n,), jnp.float64)
+        hi_k, lo_k = hi[keep_rows_d], lo[keep_rows_d]
+        shift = _split_fixed_shift(
+            hi_k[:, fixed_cols_d], lo_k[:, fixed_cols_d],
+            inv_e[keep_rows_d], inv_d[fixed_cols_d], fv)
+        if isinstance(A, ScaledView):
+            hi_c, lo_c = _unscale_split_blocks(
+                hi_k[:, keep_dev], lo_k[:, keep_dev],
+                inv_e[keep_rows_d], inv_d[keep_dev])
+        else:
+            hi_c, lo_c = hi_k[:, keep_dev], lo_k[:, keep_dev]
+        A_c = SplitMatrix(hi_c, lo_c)
+        l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold = _fold_vectors(
+            qp_data.l, qp_data.u, qp_data.lb, qp_data.ub,
+            qp_data.P_diag, c, c0, keep_rows_d, keep_dev, fixed_cols_d,
+            fv, shift)
+    else:
+        A_c, l_c, u_c, lb_c, ub_c, P_c, c_c, c0_fold, shift = \
+            _fold_compact(
+                A, qp_data.l, qp_data.u, qp_data.lb, qp_data.ub,
+                qp_data.P_diag, c, c0, keep_rows_d, keep_dev,
+                fixed_cols_d, fv)
     data_c = QPData(P_c, A_c, l_c, u_c, lb_c, ub_c)
     idx_c = np.searchsorted(keep_cols, idx_np[free_slots])
     fp = bucket_fingerprint({
@@ -292,4 +393,55 @@ def build_plan(qp_data: QPData, c, c0, nonant_idx, fixed_mask,
         fixed_slots_dev=jnp.asarray(fixed_slots),
         idx_c=jnp.asarray(idx_c), fixed_colvals=fv,
         data_c=data_c, c_c=c_c, c0_fold=c0_fold,
+        rhs_shift=shift, keep_rows_np=keep_rows, keep_cols_np=keep_cols,
         meta={"bucket_cached": seen})
+
+
+# ---------------- cross-bucket warm transplant ----------------
+
+@jax.jit
+def _transplant_rescale(x, yA, yB, zA, zB, pos_cols, pos_rows,
+                        D_old, D_new, E_old, E_new, Eb_old, Eb_new,
+                        cs_ratio, shift_old, shift_new, ok):
+    """Gather + rescale one mode's SCALED warm ADMM iterates from the
+    old width into a new compacted width (full→compacted or
+    compacted→compacted; the host caller verifies the new kept set is
+    a subset of the old and builds ``pos_cols``/``pos_rows`` — new
+    position j came from old position pos[j]).
+
+    Scaling algebra (all quantities scaled, per ops/qp_solver): an
+    UNSCALED iterate x_u relates to the scaled one by x = x_u / D, row
+    duals by yA = cs·y_u/E, bound duals by yB = cs·y_u/Eb, row slacks
+    by zA = E·(A x_u − shift) (the compacted rhs moved by −shift), and
+    bound slacks by zB = Eb·x_u. Re-expressing the same unscaled point
+    under the new factors' (D, E, Eb, cost_scale, shift):
+
+        x'  = x[pos_c]  · D_old[pos_c] / D_new
+        yA' = yA[pos_r] · cs_ratio · E_old[pos_r] / E_new
+        yB' = yB[pos_c] · cs_ratio · Eb_old[pos_c] / Eb_new
+        zA' = E_new · (zA[pos_r]/E_old[pos_r] + shift_old[:,pos_r]
+                       − shift_new)
+        zB' = zB[pos_c] · Eb_new / Eb_old[pos_c]
+
+    Scaling vectors may be shared (1-D) or per-scenario (2-D, batched-A
+    or per-scenario-rho factors); ``cs_ratio`` scalar or (S,). Both
+    sides normalize to broadcastable (1|S, ·) rows, so old and new
+    factor forms can even differ.
+
+    ``ok`` is an (S,) keep mask (hospital/dirty scenarios excluded):
+    excluded rows multiply to exactly the cold-state zeros."""
+    def b2(v):
+        return v if v.ndim == 2 else v[None, :]
+
+    csr = cs_ratio if jnp.ndim(cs_ratio) == 0 else cs_ratio[:, None]
+    okf = ok.astype(x.dtype)[:, None]
+    x_n = x[:, pos_cols] * b2(D_old)[:, pos_cols] / b2(D_new) * okf
+    yA_n = yA[:, pos_rows] * csr \
+        * (b2(E_old)[:, pos_rows] / b2(E_new)) * okf
+    yB_n = yB[:, pos_cols] * csr \
+        * (b2(Eb_old)[:, pos_cols] / b2(Eb_new)) * okf
+    zA_n = (b2(E_new)
+            * (zA[:, pos_rows] / b2(E_old)[:, pos_rows]
+               + shift_old[:, pos_rows] - shift_new)) * okf
+    zB_n = zB[:, pos_cols] * (b2(Eb_new) / b2(Eb_old)[:, pos_cols]) * okf
+    return x_n, yA_n, yB_n, zA_n, zB_n

@@ -18,7 +18,8 @@ observability catalog live in doc/streaming.md.
 """
 
 from .pipeline import ChunkPipeline                      # noqa: F401
-from .quant import Int8Field, dequantize, quantize_field  # noqa: F401
+from .quant import (Int8Field, dequantize,                # noqa: F401
+                    dequantize_cols, quantize_field)
 from .source import (ScenarioSource, StreamedSource,      # noqa: F401
                      SynthesizedSource, make_source)
 from .synth import (SOURCE_FIELDS, SYNTH_FIELDS,          # noqa: F401

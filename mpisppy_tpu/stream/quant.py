@@ -128,3 +128,19 @@ def dequantize(tmpl_dev, varying_dev, q_dev, scale_dev, zero_dev,
     delta = scale_dev * q_dev.astype(jnp.float32) + zero_dev
     delta = jnp.where(varying_dev[None, :], delta, 0.0)
     return tmpl_dev.astype(dtype)[None, :] + delta.astype(dtype)
+
+
+def dequantize_cols(tmpl_dev, vidx_dev, qv_dev, scale_dev, zero_dev,
+                    dtype):
+    """Varying-columns-only dequantization: the wire carries q over
+    the VARYING columns alone (``qv = q[:, varying]``) and the deltas
+    scatter into a broadcast template row on device. Same arithmetic
+    as :func:`dequantize` on the varying columns; non-varying columns
+    are the template verbatim (instead of template + 0.0 — identical
+    values). This is what keeps ``stream.bytes_shipped`` honest when
+    few columns vary: the booked bytes ARE the staged buffer's."""
+    delta = scale_dev * qv_dev.astype(jnp.float32) + zero_dev
+    rows = qv_dev.shape[0]
+    base = jnp.broadcast_to(tmpl_dev.astype(dtype)[None, :],
+                            (rows, tmpl_dev.shape[0]))
+    return base.at[:, vidx_dev].add(delta.astype(dtype))

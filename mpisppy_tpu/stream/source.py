@@ -49,7 +49,7 @@ import numpy as np
 
 from .. import obs
 from .pipeline import ChunkPipeline
-from .quant import dequantize, quantize_field
+from .quant import dequantize_cols, quantize_field
 from .synth import SOURCE_FIELDS, synth_values
 
 # is_eq tolerance must match ops/qp_solver._setup_vectors' predicate
@@ -104,6 +104,13 @@ class ScenarioSource:
         self._layout_key = None
         self._np_ids = None          # list[np.ndarray] per chunk
         self._pipeline = None
+        # out-of-band booking flag: a compaction transition's one full
+        # restage books its bytes on its own counter, not the
+        # per-iteration bytes_shipped the flatness verdict reads
+        self._oob_book = False
+        # whether the bound layout stages COMPACTED blocks (streamed
+        # sources under an active shrink plan; see install_compacted)
+        self._bind_compacted = False
         self._status = {"source": self.kind, "chunks_shipped": 0,
                         "bytes_shipped": 0, "synth_chunks": 0,
                         "int8_fallbacks": 0, "direct_fetches": 0}
@@ -116,16 +123,21 @@ class ScenarioSource:
         once per layout change, never per iteration."""
         return self._layout_key
 
-    def bind(self, key, np_ids):
+    def bind(self, key, np_ids, compacted=False):
         """(Re)bind the chunk layout: ``np_ids[ci]`` are chunk ci's
         global scenario rows in chunk-row order (tail chunks repeat
         their last row; sharded chunks are device-major strided —
         exactly core/ph's slice maps). A changed layout tears down the
-        pipeline; an unchanged one is a no-op."""
+        pipeline; an unchanged one is a no-op. ``compacted``: this
+        layout stages the compacted store (streamed sources after
+        ``install_compacted``) — the flag is part of the layout, so a
+        fixed-mode full-width bind and a shrunk bind never share a
+        key."""
         if key == self._layout_key:
             return
         self.close()
         self._layout_key = key
+        self._bind_compacted = bool(compacted)
         self._np_ids = [np.asarray(ids) for ids in np_ids]
         self._pipeline = self._make_pipeline()
 
@@ -149,12 +161,13 @@ class ScenarioSource:
         obs.counter_add("stream.direct_fetches")
         return self._stage_chunk(ci)
 
-    def rows(self, np_ids) -> dict:
+    def rows(self, np_ids, compacted=None) -> dict:
         """Device blocks for arbitrary scenario rows (the hospital's
-        per-scenario rescue assembly)."""
+        per-scenario rescue assembly). ``compacted`` overrides the
+        bound layout's store selection (None: follow the bind)."""
         self._status["direct_fetches"] += 1
         obs.counter_add("stream.direct_fetches")
-        return self._stage_rows(np.asarray(np_ids))
+        return self._stage_rows(np.asarray(np_ids), compacted=compacted)
 
     def _stage_chunk(self, ci: int) -> dict:
         return self._stage_rows(self._np_ids[ci])
@@ -194,9 +207,16 @@ class ScenarioSource:
         else:
             out = jax.device_put(a_np, self.sharding(np.ndim(a_np)))
         nb = int(np.asarray(a_np).nbytes)
-        self._status["bytes_shipped"] += nb
         obs.counter_add("xfer.device_put_bytes", nb)
-        obs.counter_add("stream.bytes_shipped", nb)
+        if self._oob_book:
+            # transition restage: its one-off full-width bytes must not
+            # pollute the per-iteration bytes_shipped flatness signal
+            self._status["compacted_restage_bytes"] = \
+                self._status.get("compacted_restage_bytes", 0) + nb
+            obs.counter_add("stream.compacted_restage_bytes", nb)
+        else:
+            self._status["bytes_shipped"] += nb
+            obs.counter_add("stream.bytes_shipped", nb)
         return out
 
 
@@ -218,7 +238,11 @@ class StreamedSource(ScenarioSource):
         super().__init__(dtype, depth=depth, sharding=sharding)
         self._store = {}       # field -> ("const", tmpl) | ("f64", arr)
         #                        | ("int8", Int8Field)
+        self._cstore = None    # compacted-width twin (install_compacted)
         self._tmpl_dev = {}
+        self._tmpl_dev_c = {}
+        self._status["compacted_transitions"] = 0
+        self._status["compacted_restage_bytes"] = 0
         self.install(batch, int8=int8, int8_tol=int8_tol)
 
     def install(self, batch, int8=None, int8_tol=None):
@@ -231,7 +255,10 @@ class StreamedSource(ScenarioSource):
             self._int8_tol = float(int8_tol)
         self.close()           # a new tenant's data invalidates staging
         self._store = {}
+        self._cstore = None    # a new tenant's widths are full again
         self._tmpl_dev = {}
+        self._tmpl_dev_c = {}
+        self._S = int(getattr(batch, "S", np.asarray(batch.l).shape[0]))
         for f in self.fields:
             a = np.asarray(getattr(batch, f), np.float64)
             tmpl = a[0]
@@ -251,36 +278,52 @@ class StreamedSource(ScenarioSource):
     def host_nbytes(self) -> int:
         """Host residency of the store (the int8 win is visible here:
         Int8Field.nbytes counts the packed representation)."""
-        return sum(val.nbytes for _, val in self._store.values())
+        nb = sum(val.nbytes for _, val in self._store.values())
+        if self._cstore is not None:
+            nb += sum(val.nbytes for _, val in self._cstore.values())
+        return nb
 
-    def _stage_rows(self, ids) -> dict:
+    def _stage_rows(self, ids, compacted=None) -> dict:
         import jax.numpy as jnp
 
+        if compacted is None:
+            compacted = self._bind_compacted
+        if compacted and self._cstore is None:
+            raise RuntimeError(
+                "compacted staging requested before install_compacted")
+        store = self._cstore if compacted else self._store
+        cache = self._tmpl_dev_c if compacted else self._tmpl_dev
         out = {}
         rows = ids.shape[0]
         for f in self.fields:
-            kind, val = self._store[f]
+            kind, val = store[f]
             if kind == "const":
-                td = self._tmpl_dev.get(f)
+                td = cache.get(f)
                 if td is None:
                     # pre-cast on host: ship engine-dtype bytes, not
                     # f64 ones (one-time here; the per-chunk f64
                     # branch below pays per iteration)
-                    td = self._tmpl_dev[f] = self._put(
+                    td = cache[f] = self._put(
                         np.asarray(val, _np_dtype(self.dtype)),
                         repl=True)
                 out[f] = jnp.broadcast_to(td[None, :], (rows,) + td.shape)
             elif kind == "int8":
-                td = self._tmpl_dev.get(f)
+                td = cache.get(f)
                 if td is None:
-                    # template row + varying mask ship once, replicated
-                    td = self._tmpl_dev[f] = (
+                    # template row + varying column INDEX ship once,
+                    # replicated; per chunk the wire carries q over the
+                    # varying columns alone — bytes_shipped books the
+                    # actually-staged buffer, not the full row width
+                    vidx = np.flatnonzero(val.varying).astype(np.int32)
+                    td = cache[f] = (
                         self._put(np.asarray(val.tmpl, np.float64),
                                   repl=True),
-                        self._put(val.varying, repl=True))
-                out[f] = dequantize(td[0], td[1], self._put(val.q[ids]),
-                                    self._put(val.scale[ids]),
-                                    self._put(val.zero[ids]), self.dtype)
+                        self._put(vidx, repl=True),
+                        vidx)
+                out[f] = dequantize_cols(
+                    td[0], td[1], self._put(val.q[ids][:, td[2]]),
+                    self._put(val.scale[ids]),
+                    self._put(val.zero[ids]), self.dtype)
             else:
                 # cast HOST-side: an f32 engine must not pay f64 wire
                 # bytes per chunk per pass (the f64->f32 rounding is
@@ -290,18 +333,77 @@ class StreamedSource(ScenarioSource):
                 # the same way)
                 out[f] = self._put(val[ids].astype(
                     _np_dtype(self.dtype)))
-        self._status["chunks_shipped"] += 1
-        obs.counter_add("stream.chunks_shipped")
+        if not self._oob_book:   # transition restages aren't chunks
+            self._status["chunks_shipped"] += 1
+            obs.counter_add("stream.chunks_shipped")
         return out
 
-    def setup_arrays(self, dtype):
+    def stage_full(self) -> dict:
+        """One out-of-band FULL-width staging of every scenario row —
+        the compaction transition's build_plan input. Its bytes book on
+        ``stream.compacted_restage_bytes`` (not the per-iteration
+        ``bytes_shipped`` flatness signal) and it counts as neither a
+        chunk nor a direct fetch."""
+        self._oob_book = True
+        try:
+            return self._stage_rows(np.arange(self._S), compacted=False)
+        finally:
+            self._oob_book = False
+
+    def install_compacted(self, plan):
+        """Rebuild the host store at a shrink plan's compacted widths.
+        The folded/shifted ``l``/``u`` and kept-column ``lb``/``ub``
+        come D2H once per transition from the plan's device blocks,
+        then re-run const detection and int8 re-quantization at the
+        compacted width (a block that gated full-width may fail the
+        gate compacted — it falls back to f64 and books the fallback).
+        ``c`` stays the FULL-width store entry: the loop gathers kept
+        columns per chunk, and objective assembly wants full width.
+        The device values round-trip exactly (engine dtype -> f64 host
+        -> engine dtype), so compacted+streamed chunks are bit-equal
+        to compacted+resident slices wherever int8 is off."""
+        self.close()             # the layout is about to change width
+        cstore = {}
+        for f, dev in (("l", plan.data_c.l), ("u", plan.data_c.u),
+                       ("lb", plan.data_c.lb), ("ub", plan.data_c.ub)):
+            # once-per-transition compacted-store pull; the transition
+            # already syncs to refactorize
+            a = np.asarray(dev, np.float64)
+            obs.counter_add("xfer.d2h_bytes", a.nbytes)
+            tmpl = a[0]
+            if a.shape[0] > 1 and (a == tmpl[None, :]).all():
+                cstore[f] = ("const", tmpl.copy())
+                continue
+            if self._int8:
+                fld = quantize_field(a, tmpl, self._int8_tol)
+                if fld is not None:
+                    cstore[f] = ("int8", fld)
+                    continue
+                self._status["int8_fallbacks"] += 1
+                obs.counter_add("stream.int8_fallbacks")
+                obs.event("stream.int8_fallback",
+                          {"field": f, "compacted": True})
+            cstore[f] = ("f64", a)
+        cstore["c"] = self._store["c"]
+        self._cstore = cstore
+        self._tmpl_dev_c = {}
+        self._status["compacted_transitions"] += 1
+        obs.counter_add("stream.compacted_transitions")
+
+    def setup_arrays(self, dtype, keep_cols=None):
         """Exact 2-row setup surrogates from one host pass over the
-        store (see the module docstring)."""
+        store (see the module docstring). ``keep_cols``: build the
+        COMPACTED problem's surrogates — l/u/lb/ub patterns over the
+        compacted store (the folded/shifted values the compacted
+        factors actually consume), the cost-scale surrogate as the
+        FULL per-column |c| max gathered at the kept columns (gather
+        and per-column max commute, so the scale is exact)."""
         import jax.numpy as jnp
 
+        store = self._store if keep_cols is None else self._cstore
         vals = {}
         for f in self.fields:
-            kind, val = self._store[f]
+            kind, val = store[f]
             if kind == "const":
                 vals[f] = val[None, :]
             elif kind == "int8":
@@ -317,6 +419,8 @@ class StreamedSource(ScenarioSource):
         eq_cols = _eq_pattern(vals["lb"], vals["ub"], dtype).all(axis=0)
         c_max = np.abs(np.asarray(vals["c"],
                                   _np_dtype(dtype))).max(axis=0)
+        if keep_cols is not None:
+            c_max = c_max[np.asarray(keep_cols)]
         l2, u2 = _surrogate_pair(eq_rows)
         lb2, ub2 = _surrogate_pair(eq_cols)
         c2 = np.broadcast_to(c_max, (2,) + c_max.shape)
@@ -361,7 +465,9 @@ class SynthesizedSource(ScenarioSource):
     def prefetch_alive(self) -> bool:
         return False
 
-    def bind(self, key, np_ids):
+    def bind(self, key, np_ids, compacted=False):
+        # compacted staging never applies: synthesis is full-width by
+        # construction (and validate() keeps shrink_compact off it)
         if key == self._layout_key:
             return
         self._layout_key = key
@@ -409,7 +515,7 @@ class SynthesizedSource(ScenarioSource):
         obs.counter_add("stream.direct_fetches")
         return self.chunk(ci)
 
-    def rows(self, np_ids) -> dict:
+    def rows(self, np_ids, compacted=None) -> dict:
         self._status["direct_fetches"] += 1
         obs.counter_add("stream.direct_fetches")
         import jax.numpy as jnp

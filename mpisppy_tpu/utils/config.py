@@ -117,6 +117,10 @@ class AlgoConfig:
     shrink_buckets: str = "0.25,0.5,0.75"   # fixed-fraction thresholds
     shrink_rho: bool = False        # per-slot device-side adaptive rho
     shrink_rho_interval: int = 1    # iterations between rho updates
+    shrink_transplant: bool = True  # warm-state transplant across
+    #                                 bucket transitions (iterates-only
+    #                                 free-slot gather; False = the old
+    #                                 cold-rebuild spelling)
     # ---- scenario streaming (mpisppy_tpu/stream, doc/streaming.md):
     # per-chunk staging of the per-scenario vector blocks instead of
     # full-width HBM residency ----
@@ -162,6 +166,7 @@ class AlgoConfig:
             "shrink_buckets": self.shrink_buckets,
             "shrink_rho": self.shrink_rho,
             "shrink_rho_interval": self.shrink_rho_interval,
+            "shrink_transplant": self.shrink_transplant,
             # stream knobs ride to_options() so they reach the engine
             # AND the serve bucket fingerprint (a streamed engine's
             # surrogate qp_data and host store must never be leased to
@@ -242,12 +247,14 @@ class AlgoConfig:
         if self.aph_gamma <= 0:
             raise ValueError("aph_gamma must be positive (z-update "
                              "damping γ)")
-        if self.scenario_source != "resident" and self.shrink_compact:
+        if self.scenario_source == "synthesized" and self.shrink_compact:
             raise ValueError(
-                "shrink_compact folds FULL-width data constants and "
-                "cannot run over a streamed/synthesized scenario "
-                "source (the device fixer alone — shrink_fix — "
-                "composes fine)")
+                "shrink_compact cannot run over a SYNTHESIZED scenario "
+                "source (the generator manufactures full-width blocks "
+                "in-kernel; there is no host store to re-block at the "
+                "compacted width — streamed sources compose, and the "
+                "device fixer alone — shrink_fix — composes with "
+                "everything)")
         # the combined rule (ISSUE 7 small fix): an explicitly-fused
         # kernel unrolls the IR sweeps statically — out-of-band counts
         # must fail here with a clear error, not as a deep jit failure.

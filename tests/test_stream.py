@@ -505,6 +505,117 @@ def test_hospital_rescues_flagged_row_under_streaming(mem_obs):
     ph.close_stream()
 
 
+# ---------------- shrink x stream composition (ISSUE 17) ----------------
+
+def uc_int_batch(S=6):
+    """Integer UC through the vector patch: shared-structure (so it
+    streams) AND carries binaries (so the device fixer fixes and
+    compaction engages) — the one family both subsystems accept."""
+    return build_batch(uc.scenario_creator, uc.make_tree(S),
+                       creator_kwargs=dict(UC_KW,
+                                           relax_integrality=False),
+                       vector_patch=uc.scenario_vector_patch)
+
+
+SHRINK_STREAM_OPTS = {
+    "defaultPHrho": 50.0, "PHIterLimit": 10, "convthresh": 0.0,
+    "subproblem_chunk": 2, "subproblem_max_iter": 4000,
+    "subproblem_eps": 1e-6, "iter0_infeasibility_abort": False,
+    "shrink_fix": True, "shrink_compact": True, "shrink_buckets": "0.1",
+    "id_fix_list_fct": lambda b: _uniform_fix_list(b, tol=1e-2, nb=3,
+                                                   lb=3, ub=3)}
+
+
+def _uniform_fix_list(b, **kw):
+    from mpisppy_tpu.extensions.fixer import uniform_fix_list
+    return uniform_fix_list(b, **kw)
+
+
+def test_streamed_compacted_bit_equal_resident_compacted(tmp_path):
+    """ISSUE 17 acceptance: a compacted+streamed wheel runs end to end
+    bit-identical to compacted+resident on one device (the host store
+    re-blocks at the compacted width; the transition pays ONE
+    out-of-band full restage booked on its own counter), and the
+    per-iteration ``stream.bytes_shipped`` is STRICTLY lower after the
+    first compaction than before it — UC's varying ``ub`` block stages
+    at the compacted column width."""
+    import json
+
+    ph0 = PH(uc_int_batch(), options=dict(SHRINK_STREAM_OPTS))
+    r0 = ph0.ph_main()
+    assert ph0._shrink_status["compactions"] == 1
+    obs.configure(out_dir=str(tmp_path))
+    try:
+        ph1 = PH(uc_int_batch(), options=dict(SHRINK_STREAM_OPTS,
+                                              scenario_source="streamed"))
+        r1 = ph1.ph_main()
+    finally:
+        obs.shutdown()
+    assert ph1._shrink_status["compactions"] == 1
+    assert ph1._shrink_status["n_cols"] \
+        == ph0._shrink_status["n_cols"] < ph1.batch.n
+    assert r1 == r0
+    np.testing.assert_array_equal(np.asarray(ph1.xbar),
+                                  np.asarray(ph0.xbar))
+    np.testing.assert_array_equal(np.asarray(ph1.W), np.asarray(ph0.W))
+    ss = ph1._stream_source._status
+    assert ss["compacted_transitions"] == 1
+    assert ss["compacted_restage_bytes"] > 0
+    # the per-iteration wire: strictly fewer bytes per pass once the
+    # chunks stage compacted blocks. The transition iteration itself
+    # mixes widths (last full pass + the out-of-band restage) —
+    # compare the clean steady states on either side of it.
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    iters = [e for e in events if e.get("type") == "ph.iteration"]
+    deltas = [e.get("counter_deltas", {}) for e in iters]
+    tr = [i for i, d in enumerate(deltas)
+          if d.get("stream.compacted_transitions", 0)]
+    assert len(tr) == 1, f"expected one transition iteration: {tr}"
+    shipped = [d.get("stream.bytes_shipped", 0) for d in deltas]
+    before = [s for s in shipped[:tr[0]] if s > 0]
+    after = [s for s in shipped[tr[0] + 1:] if s > 0]
+    assert before and after
+    assert max(after) < min(before), \
+        f"compacted passes must ship fewer bytes: {before} -> {after}"
+    # the one-off restage booked out of band, NOT on bytes_shipped
+    assert sum(d.get("stream.compacted_restage_bytes", 0)
+               for d in deltas) == ss["compacted_restage_bytes"]
+    ph1.close_stream()
+    ph0.close_stream()
+
+
+def test_streamed_compacted_compile_count_tracks_transitions(tmp_path):
+    """ISSUE 17 acceptance: compile count still == bucket transitions
+    under streaming — a second same-shape streamed compacted wheel
+    hits the shape registry and compiles NOTHING."""
+    from mpisppy_tpu.ops import shrink as shrink_ops
+
+    shrink_ops._BUCKET_REGISTRY.clear()
+    obs.configure(out_dir=str(tmp_path))
+    try:
+        ph_a = PH(uc_int_batch(), options=dict(SHRINK_STREAM_OPTS,
+                                               scenario_source="streamed"))
+        ph_a.ph_main()
+        assert ph_a._shrink_status["compactions"] == 1
+        ctr = obs.counters_snapshot()
+        assert ctr.get("shrink.bucket.compile", 0) == 1
+        c0 = ctr.get("jax.compiles", 0)
+        ph_a.close_stream()
+        ph_b = PH(uc_int_batch(), options=dict(SHRINK_STREAM_OPTS,
+                                               scenario_source="streamed"))
+        ph_b.ph_main()
+        assert ph_b._shrink_status["compactions"] == 1
+        ctr2 = obs.counters_snapshot()
+        assert ctr2.get("shrink.bucket.cache_hit", 0) >= 1
+        assert ctr2.get("jax.compiles", 0) - c0 == 0, \
+            "a same-shape streamed wheel's transition must compile " \
+            "nothing"
+        ph_b.close_stream()
+    finally:
+        obs.shutdown()
+
+
 # ---------------- config / CLI / serve plumbing ----------------
 
 def test_algo_config_stream_validation_and_options():
@@ -519,8 +630,13 @@ def test_algo_config_stream_validation_and_options():
     with pytest.raises(ValueError, match="stream_int8"):
         AlgoConfig(scenario_source="synthesized",
                    stream_int8=True).validate()
+    # the shrink x stream composition: streamed sources COMPOSE with
+    # compaction (the host store re-blocks at the compacted width);
+    # only synthesized sources — full-width by construction — reject
+    AlgoConfig(scenario_source="streamed", shrink_fix=True,
+               shrink_compact=True).validate()
     with pytest.raises(ValueError, match="shrink_compact"):
-        AlgoConfig(scenario_source="streamed", shrink_fix=True,
+        AlgoConfig(scenario_source="synthesized", shrink_fix=True,
                    shrink_compact=True).validate()
 
 

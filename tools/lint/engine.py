@@ -301,6 +301,15 @@ SYNC_ALLOW_DEFAULT = {
         "StreamedSource._stage_rows":
             "host gather of the host store feeding the H2D put — "
             "host numpy indexing, no device readback",
+        "StreamedSource.stage_full":
+            "once-per-compaction-transition full restage (build_plan "
+            "input) — out-of-band by contract, booked on "
+            "stream.compacted_restage_bytes, never per iteration",
+        "StreamedSource.install_compacted":
+            "once-per-transition compacted host-store rebuild: the "
+            "single D2H pull of the plan's folded blocks plus host "
+            "const/int8 re-packing — transition-time, the iteration "
+            "chain never enters it",
         "StreamedSource.setup_arrays":
             "setup-time host reductions over the host store (the "
             "exact eq-pattern/cost-scale surrogates), once per engine",

@@ -266,6 +266,66 @@ def run_stream_smoke(work_dir: str) -> int:
         return 3
     print(f"regression_gate: stream smoke ok (synth chunks "
           f"{sm['synth_chunks']}, steady-state device_put flat)")
+    # shrink×stream rider (ISSUE 17, doc/streaming.md): a
+    # compacted+STREAMED integer-UC wheel — one bucket transition must
+    # re-block the host store at the compacted width, after which the
+    # per-iteration shipped bytes drop strictly and go flat, the
+    # restage books out-of-band, and the transition's warm transplant
+    # lands without a cold fallback. Analyze's shrink + stream
+    # summaries are the judge, same as the flat contract above.
+    tdir2 = os.path.join(work_dir, "stream_shrink_telemetry")
+    cmd = [sys.executable, "-m", "mpisppy_tpu", "uc",
+           "--num-scens", "6", "--model-kwargs",
+           '{"num_gens":3,"num_hours":6,"relax_integrality":false}',
+           "--scenario-source", "streamed",
+           "--subproblem-chunk", "2", "--max-iterations", "10",
+           "--convthresh", "-1", "--default-rho", "50",
+           "--subproblem-max-iter", "4000",
+           "--subproblem-eps", "1e-6",
+           "--shrink-fix", "--shrink-fix-iters", "2",
+           "--shrink-fix-tol", "1e-2", "--shrink-compact",
+           "--shrink-buckets", "0.1", "--telemetry-dir", tdir2]
+    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=600)
+    if r.returncode != 0:
+        print(f"regression_gate: compacted streamed UC wheel failed "
+              f"(rc {r.returncode})")
+        return r.returncode or 1
+    from mpisppy_tpu.obs.analyze import shrink_summary
+    run2 = load_run(tdir2)
+    sm2, sh2 = streaming_summary(run2), shrink_summary(run2)
+    if sm2 is None or sh2 is None or not sh2.get("compactions") \
+            or not sm2.get("compacted_transitions"):
+        print("regression_gate: STREAM SMOKE FAILURE — the compacted "
+              "streamed wheel never re-blocked (compactions "
+              f"{None if sh2 is None else sh2.get('compactions')}, "
+              "transitions "
+              f"{None if sm2 is None else sm2.get('compacted_transitions')})")
+        return 3
+    ship = [r_["bytes_shipped"] for r_ in sm2["per_iteration"]]
+    trans_i = max(i for i, r_ in enumerate(sm2["per_iteration"])
+                  if r_["compacted_transitions"])
+    pre = [b for b in ship[:trans_i] if b]
+    post = [b for b in ship[trans_i + 1:] if b]
+    if not pre or not post or max(post) >= min(pre):
+        print("regression_gate: STREAM SMOKE REGRESSION — shipped "
+              "bytes did not drop across the compaction "
+              f"(per-iteration: {ship})")
+        return 3
+    if sm2.get("device_put_flat_steady_state") is False:
+        print("regression_gate: STREAM SMOKE REGRESSION — post-"
+              "transition device_put deltas are not flat "
+              f"(per-iteration: "
+              f"{[r_['device_put_bytes'] for r_ in sm2['per_iteration']]})")
+        return 3
+    if sh2.get("transplant_cold_fallbacks"):
+        print("regression_gate: STREAM SMOKE REGRESSION — the bucket "
+              "transition fell back to a cold restart "
+              f"({sh2['transplant_cold_fallbacks']} fallbacks)")
+        return 3
+    print(f"regression_gate: shrink-stream smoke ok (shipped/iter "
+          f"{min(pre)} -> {max(post)}, restage "
+          f"{sm2['compacted_restage_bytes']}B out-of-band, "
+          f"transplants {sh2['transplants']})")
     return 0
 
 
