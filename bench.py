@@ -543,6 +543,27 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
                 rows[0]["stream"] = src.status()
         except Exception:
             pass    # a kill-path flush must never die on diagnostics
+    # measured-roofline stamp (ISSUE 18): the last iteration's MFU,
+    # HBM bandwidth, and FLOPs/iter from the XLA cost-model capture
+    # (obs/profile.py) — the measured successor to the estimate-only
+    # est_hbm_bytes_per_iter story. last_iteration() is one attribute
+    # read on a plain dict (no locks), so the SIGTERM flush stamps it
+    # too, unlike the counters_snapshot block below.
+    if rows:
+        try:
+            from mpisppy_tpu.obs import profile as _obs_profile
+            fig = _obs_profile.last_iteration()
+            if fig:
+                rows[0]["profile"] = {
+                    "mfu": fig.get("mfu"),
+                    "hbm_gbps": fig.get("hbm_gbps"),
+                    "hbm_util": fig.get("hbm_util"),
+                    "flops_per_iter": fig.get("flops_per_iter"),
+                    "hbm_bytes_per_iter":
+                        fig.get("hbm_bytes_per_iter"),
+                }
+        except Exception:
+            pass    # a kill-path flush must never die on diagnostics
     # device incumbent-pool anatomy (ISSUE 9): mode, pool shape, round
     # and improvement counts of the timed window, so the gap row says
     # whether the inner bound came from the device pool or the host

@@ -2482,7 +2482,15 @@ class PHBase(SPBase):
                             # row and its --compare REGRESSION read
                             # these
                             "shrink.transplants",
-                            "shrink.transplant_cold_fallbacks")
+                            "shrink.transplant_cold_fallbacks",
+                            # measured roofline (obs/profile.py,
+                            # doc/roofline.md): XLA cost-model FLOPs
+                            # and bytes-accessed booked by the
+                            # instrumented jit entries THIS iteration —
+                            # analyze joins these deltas against the
+                            # span timeline for MFU/HBM utilization
+                            "profile.flops",
+                            "profile.hbm_bytes")
 
     def iteration_record(self, it, seconds, phase_before, counters_before):
         """The structured per-iteration convergence record (the
@@ -2538,6 +2546,18 @@ class PHBase(SPBase):
             k: ctr.get(k, 0) - counters_before.get(k, 0)
             for k in self._ITER_DELTA_COUNTERS
             if ctr.get(k, 0) != counters_before.get(k, 0)}
+        deltas = rec["counter_deltas"]
+        if "profile.flops" in deltas or "profile.hbm_bytes" in deltas:
+            # measured roofline per iteration (obs/profile.py): MFU +
+            # HBM figures from this iteration's cost-model deltas;
+            # note_iteration also refreshes the profile.iter.* gauges
+            # and the signal-safe dict bench/the hub live plane read
+            from ..obs import profile as _obs_profile
+            fig = _obs_profile.note_iteration(
+                it, seconds, deltas.get("profile.flops", 0),
+                deltas.get("profile.hbm_bytes", 0))
+            if fig is not None:
+                rec["profile"] = fig
         return rec
 
     def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,

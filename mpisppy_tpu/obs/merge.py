@@ -30,7 +30,11 @@ import os
 
 def _anchor_from_events(run_dir, role):
     """Fallback anchor for pre-anchor traces: the matching events
-    file's run_header carries the same (wall, perf_counter) pair."""
+    file's run_header carries the same (wall, perf_counter) pair.
+    Rotation-transparent by construction: a size-capped rotation
+    (obs/events.py) re-emits the ORIGINAL header — same anchor pair,
+    plus a ``rotated`` marker — as the new current file's first line,
+    so this first-line read stays correct mid-rotation."""
     name = f"events-{role}.jsonl" if role else "events.jsonl"
     path = os.path.join(run_dir, name)
     try:

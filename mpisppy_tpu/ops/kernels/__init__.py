@@ -263,9 +263,20 @@ def kernel_solve(plan: KernelPlan, factors, data, q, state, *,
         # not this driver. ``donate`` flows to the finisher: ``st``
         # aliases the block's outputs plus the caller's factor/rho
         # buffers, exactly the ownership donate=True relinquishes.
-        x_s, yA_s, yB_s, zA_s, zB_s, _, _ = pallas_kernel.fused_admm_block(
-            factors, data, q, state, n_steps=max_iter,
-            sigma=plan.sigma_host)
+        if obs.enabled():
+            # roofline capture for the pallas block (obs/profile.py);
+            # degrades to profile.unavailable if the backend's cost
+            # model cannot see through the pallas lowering
+            from ...obs import profile as _profile
+            x_s, yA_s, yB_s, zA_s, zB_s, _, _ = _profile.call(
+                "kernel.pallas", pallas_kernel.fused_admm_block,
+                factors, data, q, state, n_steps=max_iter,
+                sigma=plan.sigma_host)
+        else:
+            x_s, yA_s, yB_s, zA_s, zB_s, _, _ = \
+                pallas_kernel.fused_admm_block(
+                    factors, data, q, state, n_steps=max_iter,
+                    sigma=plan.sigma_host)
         st = state._replace(x=x_s, yA=yA_s, yB=yB_s, zA=zA_s, zB=zB_s)
         st, x, yA, yB = qp_solve(
             factors, data, q, st, donate=donate, max_iter=0,

@@ -264,11 +264,18 @@ def fused_mixed_solve(factors, A_lo, data, q, state, *, bulk_iter,
     iterates = (state.x, state.yA, state.yB, state.zA, state.zB)
     aux = (state.L, state.rho_scale, state.iters)
     fn = _fused_mixed_jit_donated if donate else _fused_mixed_jit
-    return fn(factors, A_lo, data, q, iterates, aux,
-              eps_abs, eps_rel, eps_abs_dua, eps_rel_dua,
-              bulk_iter=int(bulk_iter), tail_iter=int(tail_iter),
+    kw = dict(bulk_iter=int(bulk_iter), tail_iter=int(tail_iter),
               check_every=int(check_every),
               adaptive_rho=bool(adaptive_rho), polish=bool(polish),
               polish_iters=int(polish_iters),
               polish_chunk=int(polish_chunk), stall_rel=float(stall_rel),
               ir_sweeps=int(ir_sweeps), l_inv=bool(l_inv))
+    if obs.enabled():
+        # measured-roofline capture + compile-ledger attribution
+        # (obs/profile.py) — zero-cost when telemetry is off
+        from ...obs import profile as _profile
+        return _profile.call("kernel.fused_mixed", fn, factors, A_lo,
+                             data, q, iterates, aux, eps_abs, eps_rel,
+                             eps_abs_dua, eps_rel_dua, **kw)
+    return fn(factors, A_lo, data, q, iterates, aux,
+              eps_abs, eps_rel, eps_abs_dua, eps_rel_dua, **kw)

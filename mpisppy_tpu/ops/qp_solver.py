@@ -1197,6 +1197,12 @@ def qp_solve(factors: QPFactors, data: QPData, q, state: QPState,
     else:
         kw.pop("_segmented_caller", None)
     fn = _qp_solve_jit_donated if donate else _qp_solve_jit
+    if obs.enabled():
+        # measured-roofline capture + compile-ledger attribution
+        # (obs/profile.py) — zero-cost when telemetry is off
+        from ..obs import profile as _profile
+        return _profile.call("qp.solve", fn, factors, data, q, state,
+                             **kw)
     return fn(factors, data, q, state, **kw)
 
 
@@ -1393,11 +1399,19 @@ def qp_solve_mixed(factors: QPFactors, data: QPData, q, state: QPState,
         # remainder must not become a fresh static max_iter
         t_seg = time.perf_counter()
         fn_lo = _solve_lo_jit_donated if owned_lo else _solve_lo_jit
-        st_lo, _, _, _ = fn_lo(f_lo, d_lo, q_lo, st_lo,
-                               seg_lo, check_every, eps_lo,
-                               eps_rel_lo, alpha, adaptive_rho,
-                               polish_iters, eps_rel_lo_dua,
-                               stall_rel)
+        if obs.enabled():
+            from ..obs import profile as _profile
+            st_lo, _, _, _ = _profile.call(
+                "qp.solve_lo", fn_lo, f_lo, d_lo, q_lo, st_lo,
+                seg_lo, check_every, eps_lo, eps_rel_lo, alpha,
+                adaptive_rho, polish_iters, eps_rel_lo_dua,
+                stall_rel)
+        else:
+            st_lo, _, _, _ = fn_lo(f_lo, d_lo, q_lo, st_lo,
+                                   seg_lo, check_every, eps_lo,
+                                   eps_rel_lo, alpha, adaptive_rho,
+                                   polish_iters, eps_rel_lo_dua,
+                                   stall_rel)
         owned_lo = True
         lo_ran = True
         _trace_seg("lo-seg", t_seg, st_lo)

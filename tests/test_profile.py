@@ -1,0 +1,324 @@
+"""Measured roofline capture (mpisppy_tpu/obs/profile — ISSUE 18):
+XLA cost-model capture, MFU/HBM attribution, the compile ledger, and
+the satellites that ride the PR — event-stream rotation, truncated-run
+stamping, and the ``--compare`` MFU verdict.
+
+Coverage demanded by the issue's acceptance criteria:
+ - an instrumented call captures ``cost_analysis`` FLOPs/bytes on the
+   CPU backend (one ``profile.entry`` event per shape bucket) and
+   books cumulative ``profile.flops`` / ``profile.hbm_bytes``,
+ - the compile ledger column-sums to the observed ``jax.compiles``,
+ - a backend/lowering failure degrades to a reasoned
+   ``profile.unavailable`` counter — never a crash,
+ - ``note_iteration`` produces finite MFU/HBM figures and the
+   signal-safe ``last_iteration`` view,
+ - size-capped ``events.jsonl`` rotation mid-run is read back as ONE
+   logical stream by ``analyze`` (and keeps the merge anchor),
+ - a run killed before ``run_footer`` renders every section with an
+   explicit TRUNCATED RUN stamp (report and compare),
+ - ``analyze --compare`` books an MFU regression on a synthetically
+   slowed run.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpisppy_tpu import obs
+from mpisppy_tpu.obs import profile
+from mpisppy_tpu.obs.analyze import (compare, load_run, render_report,
+                                     roofline_summary, truncated)
+
+
+@pytest.fixture
+def telemetry(tmp_path):
+    rec = obs.configure(out_dir=str(tmp_path))
+    yield rec, tmp_path
+    obs.shutdown()
+
+
+def _events(path):
+    out = []
+    for name in sorted(os.listdir(path)):
+        if not name.startswith("events"):
+            continue
+        with open(os.path.join(path, name), encoding="utf-8") as fh:
+            out += [json.loads(ln) for ln in fh if ln.strip()]
+    return out
+
+
+# ---------------- capture ----------------
+
+def test_capture_books_cost_model_and_counters(telemetry):
+    """CPU-tier cost capture: the first call of a shape bucket lowers
+    and reads ``cost_analysis`` (finite FLOPs), every call accumulates
+    the cumulative counters, and repeat shapes never re-capture."""
+    rec, path = telemetry
+
+    @jax.jit
+    def f(a, b):
+        return a @ b + 1.0
+
+    x = jnp.ones((17, 17))
+    for _ in range(3):
+        out = profile.call("test.matmul", f, x, x)
+    assert np.isfinite(float(out[0, 0]))
+    assert obs.counter_value("profile.captures") == 1
+    fl = obs.counter_value("profile.flops")
+    assert fl > 0 and fl == 3 * (fl / 3)   # 3 identical bookings
+    assert obs.counter_value("profile.hbm_bytes") > 0
+    # a NEW shape bucket captures again
+    y = jnp.ones((9, 9))
+    profile.call("test.matmul", f, y, y)
+    assert obs.counter_value("profile.captures") == 2
+    obs.shutdown()
+    evs = [e for e in _events(path) if e["type"] == "profile.entry"]
+    assert len(evs) == 2
+    assert all(np.isfinite(e["flops"]) and e["flops"] > 0 for e in evs)
+    assert {e["entry"] for e in evs} == {"test.matmul"}
+    assert len({e["fingerprint"] for e in evs}) == 2
+    # the session also stamped its device peaks exactly once
+    dev = [e for e in _events(path) if e["type"] == "profile.device"]
+    assert len(dev) == 1 and dev[0]["peak_flops"] > 0
+
+
+def test_compile_ledger_sums_to_jax_compiles(telemetry):
+    """THE ledger invariant: every backend compile observed by the
+    session books to exactly one ledger key, so the column sum equals
+    ``jax.compiles`` — attributed entries to their ``entry|fp`` key,
+    everything else to ``(unattributed)``."""
+    rec, path = telemetry
+
+    @jax.jit
+    def g(a):
+        return jnp.sin(a) * 2.0
+
+    # unique shape so this test really compiles inside the session
+    profile.call("test.ledger", g, jnp.ones((13, 7, 3)))
+
+    @jax.jit
+    def h(a):          # an UNinstrumented jit: books unattributed
+        return a + 2.0
+
+    h(jnp.ones((11, 5, 2)))
+    snap = obs.counters_snapshot()
+    ledger = {k: v for k, v in snap.items()
+              if k.startswith("profile.ledger.compiles.")}
+    total = int(snap.get("jax.compiles", 0))
+    assert total >= 2
+    assert sum(int(v) for v in ledger.values()) == total
+    attributed = [k for k in ledger if "test.ledger|" in k]
+    assert attributed and ledger[attributed[0]] >= 1
+    assert any(k.endswith(profile.UNATTRIBUTED) for k in ledger)
+    # seconds mirror the same keys
+    assert any(k.startswith("profile.ledger.seconds.")
+               for k in snap)
+
+
+def test_unavailable_degrades_never_crashes(telemetry):
+    """Satellite: a backend whose cost model is missing (forced here
+    via a lowering that raises) books ``profile.unavailable`` with a
+    reasoned event once, and the call itself still runs."""
+    rec, path = telemetry
+
+    def bad(a):
+        return a + 1.0
+
+    def _boom(*a, **k):
+        raise RuntimeError("no cost model on this backend")
+
+    bad.lower = _boom
+    out = profile.call("test.bad", bad, jnp.ones(4))
+    assert float(out[0]) == 2.0
+    assert obs.counter_value("profile.unavailable") == 1
+    # the failure is cached: repeat calls run plainly, no re-booking
+    profile.call("test.bad", bad, jnp.ones(4))
+    assert obs.counter_value("profile.unavailable") == 1
+    obs.shutdown()
+    evs = [e for e in _events(path)
+           if e["type"] == "profile.unavailable"]
+    assert len(evs) == 1 and "no cost model" in evs[0]["reason"]
+
+
+def test_note_iteration_figures_and_last_iteration(telemetry):
+    rec, path = telemetry
+    fig = profile.note_iteration(4, 2.0, 1e9, 4e9)
+    peak_f, peak_g, _src, _kind = profile.peaks()
+    assert fig["mfu"] == pytest.approx(1e9 / 2.0 / peak_f)
+    assert fig["hbm_gbps"] == pytest.approx(4e9 / 2.0 / 1e9)
+    assert fig["hbm_util"] == pytest.approx(fig["hbm_gbps"] / peak_g)
+    assert profile.last_iteration() is fig
+    # nothing instrumented -> no figures, no stale carry-over
+    assert profile.note_iteration(5, 2.0, 0, 0) is None
+    # disabled mode: both readers are None, no allocation-path work
+    obs.shutdown()
+    assert profile.last_iteration() is None
+    assert profile.peaks() is None
+
+
+# ---------------- rotation (satellite 1) ----------------
+
+def test_event_stream_rotation_mid_run(tmp_path, monkeypatch):
+    """A tiny byte cap forces mid-run rotation; analyze reads the
+    chain back as ONE logical stream (no phantom earlier_runs), the
+    newest file leads with a continuation header, and the merge
+    anchor survives."""
+    monkeypatch.setenv("MPISPPY_TPU_TELEMETRY_ROTATE_BYTES", "4096")
+    monkeypatch.setenv("MPISPPY_TPU_TELEMETRY_ROTATE_FILES", "4")
+    obs.configure(out_dir=str(tmp_path))
+    try:
+        for i in range(200):
+            obs.event("test.tick", {"i": i, "pad": "x" * 64})
+    finally:
+        obs.shutdown()
+    base = tmp_path / "events.jsonl"
+    assert (tmp_path / "events.jsonl.1").exists()
+    with open(base, encoding="utf-8") as fh:
+        first = json.loads(fh.readline())
+    assert first["type"] == "run_header" and first["rotated"] >= 1
+    run = load_run(str(tmp_path))
+    assert run.earlier_runs == 0
+    ticks = run.of("test.tick")
+    # the oldest generations may have dropped off the 4-file cap, but
+    # the retained chain must be contiguous and ordered
+    idx = [e["i"] for e in ticks]
+    assert idx == sorted(idx) and idx[-1] == 199
+    assert len(idx) == len(set(idx))
+    assert run.of("telemetry.rotated")
+    assert not truncated(run)          # footer in the newest file
+    from mpisppy_tpu.obs.merge import _anchor_from_events
+    anchor = _anchor_from_events(str(tmp_path), role="")
+    assert anchor is not None and anchor["wall_time_unix"] > 0
+
+
+def test_rotation_disabled_by_default(telemetry):
+    rec, path = telemetry
+    for i in range(50):
+        obs.event("test.tick", {"i": i})
+    obs.shutdown()
+    assert not os.path.exists(os.path.join(str(path),
+                                           "events.jsonl.1"))
+    assert len(load_run(str(path)).of("test.tick")) == 50
+
+
+# ---------------- synthetic runs for analyze-level checks ----------
+
+def _synth_run(path, s_per_iter, run_id="synth", footer=True):
+    """Hand-written telemetry dir: N iterations of fixed profiled
+    work, so MFU is flops / s_per_iter / peak exactly."""
+    os.makedirs(path, exist_ok=True)
+    flops, hbm = 2e9, 8e9
+    header = {"t": 0.0, "type": "run_header", "schema": 2,
+              "run_id": run_id, "role": None, "pid": 1,
+              "wall_time_unix": 1000.0, "clock": "perf_counter",
+              "config": {}}
+    evs = [header,
+           {"t": 0.1, "type": "profile.device", "device_kind": "cpu",
+            "peak_flops": 1e11, "peak_hbm_gbps": 50.0,
+            "source": "table", "cpu_tier": True}]
+    for it in range(1, 4):
+        evs.append({"t": it * 10.0, "type": "ph.iteration", "iter": it,
+                    "conv": 1e-3, "seconds": s_per_iter,
+                    "phase_seconds": {"solve": s_per_iter * 0.8},
+                    "counter_deltas": {"profile.flops": flops,
+                                       "profile.hbm_bytes": hbm}})
+    counters = {"profile.flops": 3 * flops,
+                "profile.hbm_bytes": 3 * hbm,
+                "profile.captures": 1,
+                "jax.compiles": 2,
+                "profile.ledger.compiles.qp.solve|abcd": 2,
+                "profile.ledger.seconds.qp.solve|abcd": 1.5,
+                "ph.solve_loop_calls": 3}
+    if footer:
+        evs.append({"t": 40.0, "type": "run_footer",
+                    "metrics": {"counters": counters}})
+    with open(os.path.join(path, "events.jsonl"), "w",
+              encoding="utf-8") as fh:
+        for e in evs:
+            fh.write(json.dumps(e) + "\n")
+    with open(os.path.join(path, "metrics.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump({"counters": counters, "gauges": {},
+                   "histograms": {}}, fh)
+
+
+def test_roofline_summary_and_report(tmp_path):
+    _synth_run(str(tmp_path), s_per_iter=2.0)
+    run = load_run(str(tmp_path))
+    rf = roofline_summary(run)
+    assert rf["overall"]["iters"] == 3
+    assert rf["overall"]["mfu"] == pytest.approx(2e9 / 2.0 / 1e11)
+    assert rf["overall"]["hbm_gbps"] == pytest.approx(8e9 / 2.0 / 1e9)
+    assert rf["ledger_matches"] and rf["ledger_compiles"] == 2
+    text = render_report(run)
+    assert "== roofline ==" in text and "compile ledger" in text
+    assert "TRUNCATED" not in text
+
+
+def test_truncated_run_stamps_every_section(tmp_path):
+    """Satellite: a run killed before run_footer renders EVERY section
+    header with the TRUNCATED RUN stamp plus one explicit notice —
+    uniform handling, not section-dependent silence."""
+    _synth_run(str(tmp_path), s_per_iter=2.0, footer=False)
+    run = load_run(str(tmp_path))
+    assert truncated(run)
+    text = render_report(run)
+    assert "TRUNCATED RUN: no run_footer" in text
+    heads = [ln for ln in text.splitlines() if ln.startswith("== ")]
+    assert heads and all("[TRUNCATED RUN]" in ln for ln in heads)
+
+
+def test_compare_books_mfu_regression_and_truncated_stamp(tmp_path):
+    """Satellites: B runs the same profiled work 10x slower -> the
+    MFU verdict row books ``profile_mfu`` and the verdict flips to
+    REGRESSION; a truncated side stamps the compare output too."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _synth_run(a, s_per_iter=2.0, run_id="a")
+    _synth_run(b, s_per_iter=20.0, run_id="b")
+    ra, rb = load_run(a), load_run(b)
+    text, passed = compare(ra, rb)
+    assert not passed
+    assert "profile_mfu" in text and "MFU verdict [REGRESSION]" in text
+    # equal speed passes the MFU row
+    _synth_run(b, s_per_iter=2.0, run_id="b")
+    text, passed = compare(ra, load_run(b))
+    assert "MFU verdict [PASS]" in text
+    # a truncated side stamps every compare section
+    c = str(tmp_path / "c")
+    _synth_run(c, s_per_iter=2.0, run_id="c", footer=False)
+    text, _ = compare(ra, load_run(c))
+    assert "TRUNCATED RUN (B)" in text
+    assert "== compare ==  [TRUNCATED RUN]" in text
+
+
+def test_watch_tile_renders_roofline(tmp_path):
+    """Satellite: --watch's one-line roofline tile reads the live
+    plane's ``roofline`` block."""
+    from mpisppy_tpu.obs.analyze import render_watch
+    _synth_run(str(tmp_path), s_per_iter=2.0)
+    with open(os.path.join(str(tmp_path), "live.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump({"run_id": "synth", "iter": 3,
+                   "wall_time_unix": 1000.0,
+                   "roofline": {"iter": 3, "mfu": 0.01,
+                                "hbm_gbps": 4.0, "hbm_util": 0.08,
+                                "flops_per_iter": 2e9}}, fh)
+    frame, done = render_watch(str(tmp_path))
+    assert "roofline iter 3" in frame and "mfu 0.01" in frame
+    assert done    # the synthetic run has its footer
+
+
+def test_profile_smoke_gate_stage(tmp_path):
+    """The CI rider judges a dir through the same roofline_summary the
+    report renders: a synthetic healthy dir passes the ledger+MFU
+    checks it applies (the pytest re-run is exercised by the gate
+    itself, not here)."""
+    _synth_run(str(tmp_path), s_per_iter=2.0)
+    rf = roofline_summary(load_run(str(tmp_path)))
+    assert rf["ledger"] and rf["ledger_matches"]
+    mfu = rf["overall"]["mfu"]
+    assert mfu is not None and 0.0 < mfu < float("inf")
