@@ -564,6 +564,22 @@ def _gap_rows(prefix, hub, t0, t_end, baseline_s, note, rel,
                 }
         except Exception:
             pass    # a kill-path flush must never die on diagnostics
+    # wheel-forensics stamp (ISSUE 19): the current diagnosis verdict
+    # + top culprit slot/scenario (obs/diagnose.py) — snapshot() is
+    # one attribute read on a plain dict (no locks), so a SIGTERM'd
+    # campaign run dies with its diagnosis attached.
+    if rows:
+        try:
+            from mpisppy_tpu.obs import diagnose as _obs_diagnose
+            snap = _obs_diagnose.snapshot()
+            if snap:
+                rows[0]["forensics"] = {
+                    "verdict": snap.get("verdict"),
+                    "top_slot": snap.get("top_slot"),
+                    "top_scen_share": snap.get("top_scen_share"),
+                }
+        except Exception:
+            pass    # a kill-path flush must never die on diagnostics
     # device incumbent-pool anatomy (ISSUE 9): mode, pool shape, round
     # and improvement counts of the timed window, so the gap row says
     # whether the inner bound came from the device pool or the host

@@ -122,6 +122,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "call (the chunked hot loop; required by "
                         "non-resident --scenario-source). Lands in "
                         "hub_options like the programmatic spelling")
+    p.add_argument("--forensics-interval", type=int, default=None,
+                   help="sample the per-slot/per-scenario forensic "
+                        "reduction every N iterations when telemetry "
+                        "is on (default 5; 0 disables — see "
+                        "doc/forensics.md). Lands in hub_options like "
+                        "the programmatic spelling")
     # APH φ-dispatch (--hub aph; core/aph.py + ops/dispatch.py,
     # doc/aph.md)
     p.add_argument("--dispatch-frac", type=float, default=1.0,
@@ -255,6 +261,8 @@ def config_from_args(args) -> RunConfig:
     hub_options = {}
     if args.subproblem_chunk is not None:
         hub_options["subproblem_chunk"] = args.subproblem_chunk
+    if args.forensics_interval is not None:
+        hub_options["forensics_interval"] = args.forensics_interval
     spokes = [SpokeConfig(kind=k) for k in KNOWN_SPOKES
               if getattr(args, f"with_{k}")]
     # build the dict whenever ANY coordinator flag is present, so

@@ -540,6 +540,14 @@ class PHBase(SPBase):
         self.conv = None
         self._iter = 0
         self.best_bound = -float("inf")  # outer (lower, for min) bound
+        # wheel forensics (ops/forensics.py, doc/forensics.md):
+        # device-resident attribution carry + the latest unpacked
+        # sample (plain host dict: signal-safe reads). Sampled every
+        # forensics_interval iterations inside iteration_record, so
+        # the whole layer is zero-cost when telemetry is off.
+        self._forensics_every = int(opts.get("forensics_interval", 5))
+        self._forensic_state = None
+        self._forensic_last = None
 
         self._factors = {}       # prox_on -> QPFactors
         self._qp_states = {}     # prox_on -> QPState (L/rho are per-mode)
@@ -2424,6 +2432,45 @@ class PHBase(SPBase):
                 "dua_rel_max": float(dua.max()),
                 "dua_rel_mean": float(dua.mean())}
 
+    def _forensic_sample(self, it):
+        """One wheel-forensics sample (ops/forensics.py): the jitted
+        attribution reduction over the current (S, K) hub state, its
+        packed result fetched at the already-synced gate (the
+        ``residual_summary`` license — ``ph.gate_syncs`` stays O(1)),
+        unpacked and handed to the diagnosis engine. Returns the
+        sample dict, or None when the state is not ready."""
+        if self.x is None or self.conv is None:
+            return None
+        from ..obs import diagnose as _obs_diagnose
+        from ..ops import forensics as _forensics
+        xn = self.nonants_of(self.x)
+        S, K = xn.shape
+        st = self._forensic_state
+        if st is None or st.prev_w.shape != (S, K):
+            # first sample, or a shrink compaction changed the slot
+            # width: restart the carry (validity gates re-arm)
+            st = _forensics.init_state(S, K, dtype=xn.dtype)
+        kk = min(_forensics.TOPK, K)
+        ks = min(_forensics.TOPK, int(self._S_orig))
+        st, packed = _forensics.forensic_reduce(
+            st, xn, self.xbar, self.W, self.prob, self.rho,
+            kk=kk, ks=ks)
+        self._forensic_state = st
+        fx = _forensics.unpack(packed, kk, ks)
+        fx["it"] = int(it)
+        fx["n_scens"] = int(self._S_orig)
+        fx["n_slots"] = int(K)
+        shrink = None
+        if self._shrink_status is not None:
+            shrink = dict(self._shrink_status)
+            buckets = getattr(self, "_shrink_buckets", None)
+            if buckets:
+                shrink["first_bucket"] = float(buckets[0])
+        _obs_diagnose.note_sample(fx, shrink=shrink)
+        # rebind, don't mutate: the bench signal handler reads this
+        self._forensic_last = fx
+        return fx
+
     # counters whose per-iteration deltas enter the ph.iteration record
     # (the recovery machinery volume THIS iteration, plus compile
     # activity — a nonzero jax.compiles delta mid-run is a retrace)
@@ -2558,6 +2605,15 @@ class PHBase(SPBase):
                 deltas.get("profile.hbm_bytes", 0))
             if fig is not None:
                 rec["profile"] = fig
+        if self._forensics_every > 0 \
+                and it % self._forensics_every == 0:
+            # wheel forensics (ops/forensics.py, doc/forensics.md):
+            # per-slot/per-scenario convergence attribution, sampled
+            # on the interval — the record carries the sample and the
+            # diagnosis engine (obs/diagnose.py) re-runs its verdicts
+            fx = self._forensic_sample(it)
+            if fx is not None:
+                rec["forensics"] = fx
         return rec
 
     def _hospitalize(self, key, slices, solved_chunks, data, thr, w_on,

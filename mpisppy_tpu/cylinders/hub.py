@@ -554,6 +554,12 @@ class Hub(SPCommunicator):
         # renders this line (None until the first instrumented iter)
         from ..obs import profile as _obs_profile
         snap["roofline"] = _obs_profile.last_iteration()
+        # wheel-forensics tile (obs/diagnose.py): the current verdict
+        # + top culprit slot/scenario as a plain dict — analyze --watch
+        # renders this line, serve /status + /metrics ship it per wheel
+        # (None until the first forensic sample or bound check)
+        from ..obs import diagnose as _obs_diagnose
+        snap["forensics"] = _obs_diagnose.snapshot()
         return snap
 
     def _write_live_snapshot(self, force=False):
@@ -650,6 +656,23 @@ class Hub(SPCommunicator):
             return True
         return False
 
+    def _ob_spoke_kind(self):
+        """The kind of the spoke that produced the current outer bound
+        (None when unknown): resolved from ``latest_ob_char`` against
+        the live spokes — supervisor kinds when running as processes,
+        the diagnose char table otherwise."""
+        ch = getattr(self, "latest_ob_char", None)
+        if not ch or ch == " ":
+            return None
+        from ..obs.diagnose import SPOKE_CHARS
+        sup = self.supervisor
+        for i, sp in enumerate(self.spokes):
+            if getattr(sp, "converger_spoke_char", None) == ch:
+                if sup is not None and i < len(sup.kinds):
+                    return sup.kinds[i]
+                return SPOKE_CHARS.get(ch, type(sp).__name__.lower())
+        return SPOKE_CHARS.get(ch)
+
     def determine_termination(self) -> bool:
         if self._preempted:
             return True
@@ -682,6 +705,15 @@ class Hub(SPCommunicator):
                                               "consumed": f["consumed"]}
                                 for i, f in enumerate(self._spoke_flow)}
                        if self._spoke_flow else None})
+            # the diagnosis engine's bound trajectory (obs/diagnose.py
+            # STALLED_OUTER rule): every check, with the kind of the
+            # spoke that produced the current outer bound attached so
+            # a stall verdict names the frozen spoke
+            from ..obs import diagnose as _obs_diagnose
+            _obs_diagnose.note_bound_check(
+                getattr(self.opt, "_iter", None),
+                fin(self.BestOuterBound), fin(self.BestInnerBound),
+                fin(rel_gap), spoke=self._ob_spoke_kind())
         # the live plane's jax-free tail surface: an atomically-renamed
         # snapshot beside the telemetry artifacts on every termination
         # check (rate-limited; obs/live.py)

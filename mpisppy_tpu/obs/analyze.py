@@ -743,6 +743,74 @@ def aph_summary(run: Run) -> dict | None:
     }
 
 
+def forensics_summary(run: Run) -> dict | None:
+    """Wheel forensics (ops/forensics.py + obs/diagnose.py,
+    doc/forensics.md): the per-slot/per-scenario attribution samples
+    off the iteration records (or the dedicated ``forensics.sample``
+    stream on merged multi-role runs), the hub bound trajectory, and a
+    POST-MORTEM re-run of the same pure diagnosis rules the live
+    engine uses — a recorded stall is re-attributed even when the run
+    died before the live engine fired. None when the run carries no
+    forensic data at all."""
+    from . import diagnose as _diagnose
+    samples = []
+    for e in iteration_rows(run):
+        fx = e.get("forensics")
+        if isinstance(fx, dict):
+            samples.append(fx)
+    if not samples:
+        samples = [e for e in run.of("forensics.sample")
+                   if e.get("it") is not None]
+    verdict_events = [
+        {"it": e.get("it"), "verdict": e.get("verdict"),
+         "prev": e.get("prev"), "summary": e.get("summary"),
+         "evidence": e.get("evidence")}
+        for e in run.of("forensics.verdict")]
+    bound_checks = [
+        {"it": e.get("iter"), "outer": e.get("outer"),
+         "inner": e.get("inner"), "rel_gap": e.get("rel_gap"),
+         "spoke": None}
+        for e in run.of("hub.iteration")]
+    if not samples and not verdict_events:
+        return None
+    # stalled-outer spoke attribution, post-mortem: the char that
+    # produced the last outer-bound publish (screen rows stop when
+    # bounds freeze, so the LAST one names the spoke that froze);
+    # merged runs fall back to the live engine's recorded attribution
+    spoke = None
+    for e in reversed(run.of("hub.screen_row")):
+        ch = e.get("ob_char")
+        if isinstance(ch, str) and ch.strip():
+            spoke = _diagnose.SPOKE_CHARS.get(ch, ch)
+            break
+    if spoke is None:
+        for v in reversed(verdict_events):
+            sp = (v.get("evidence") or {}).get("spoke")
+            if sp:
+                spoke = sp
+                break
+    for b in bound_checks:
+        b["spoke"] = spoke
+    verdicts = _diagnose.diagnose(samples, bound_checks)
+    last = samples[-1] if samples else {}
+    return {
+        "verdict": _diagnose.overall(verdicts),
+        "verdicts": verdicts,
+        "samples": len(samples),
+        "bound_checks": len(bound_checks),
+        "verdict_events": verdict_events,
+        "last": {
+            "it": last.get("it"), "conv": last.get("conv"),
+            "osc_mean": last.get("osc_mean"),
+            "rho_log_ratio_mean": last.get("rho_log_ratio_mean"),
+            "xbar_move": last.get("xbar_move"),
+            "top_slots": last.get("top_slots"),
+            "scen_pri_shares": last.get("scen_pri_shares"),
+            "scen_dua_shares": last.get("scen_dua_shares"),
+        } if samples else None,
+    }
+
+
 def checkpoint_summary(run: Run) -> dict | None:
     """Durable checkpoint activity (mpisppy_tpu.ckpt,
     doc/fault_tolerance.md): ``ckpt.*`` counters summed across roles
@@ -1550,6 +1618,39 @@ def render_report(run: Run) -> str:
                 f"{ent['verdict']}{why}")
         L.append("")
 
+    fo = forensics_summary(run)
+    if fo is not None:
+        # ranked diagnosis (ops/forensics.py + obs/diagnose.py,
+        # doc/forensics.md): verdicts most-severe first, then the last
+        # sample's culprit leaderboards
+        L.append("== forensics ==")
+        L.append(f"verdict: {fo['verdict']}  (samples {fo['samples']}, "
+                 f"bound checks {fo['bound_checks']})")
+        for v in fo["verdicts"]:
+            L.append(f"  [{v['verdict']}] {v['summary']}"
+                     + (f" — advice: {v['advice']}"
+                        if v.get("advice") else ""))
+        last = fo.get("last")
+        if last:
+            slots = last.get("top_slots") or []
+            if slots:
+                L.append("top culprit slots (slot: |x-xbar| mass): "
+                         + "  ".join(f"{int(s)}: {_fmt(m)}"
+                                     for s, m in slots[:5]))
+            scens = last.get("scen_pri_shares") or []
+            if scens:
+                L.append("scenario residual shares (scen: share): "
+                         + "  ".join(f"{int(s)}: {_fmt(sh, 3)}"
+                                     for s, sh in scens[:5]))
+            L.append(f"osc_mean {_fmt(last.get('osc_mean'), 3)}  "
+                     f"rho log-ratio "
+                     f"{_fmt(last.get('rho_log_ratio_mean'), 3)}  "
+                     f"xbar move {_fmt(last.get('xbar_move'))}")
+        for v in fo["verdict_events"][-4:]:
+            L.append(f"  verdict event @iter {v.get('it')}: "
+                     f"{v.get('prev')} -> {v.get('verdict')}")
+        L.append("")
+
     L.append("== invariant checks ==")
     for name, ok, detail, severity in invariant_checks(run,
                                                        bound_flow=bf):
@@ -1848,6 +1949,27 @@ def compare(a: Run, b: Run, threshold=1.5,
     elif ra is not None or rb is not None:
         L.append("  roofline: profile captures on one side only — "
                  "MFU verdict [skipped]")
+    # forensics verdict row (ISSUE 19, doc/forensics.md): when a side
+    # carries forensic data, restate its diagnosis as one explicit
+    # line. A candidate whose wheel shows a stall signature the
+    # baseline lacks books a regression — a faster wheel that stopped
+    # converging is not an improvement; sides without forensic data
+    # abstain (runs predating the layer).
+    fza, fzb = forensics_summary(a), forensics_summary(b)
+    if fza is not None or fzb is not None:
+        va = fza["verdict"] if fza else None
+        vb = fzb["verdict"] if fzb else None
+        verdict = "PASS" if (fza is not None and fzb is not None) \
+            else "skipped"
+        if fzb is not None and vb != "HEALTHY" \
+                and (fza is None or va == "HEALTHY"):
+            verdict = "REGRESSION"
+            regressions.append(f"forensics_{vb.lower()}")
+        why = ""
+        if fzb is not None and fzb["verdicts"]:
+            why = f" (B: {fzb['verdicts'][0]['summary']})"
+        L.append(f"  forensics: A={va or 'n/a'} B={vb or 'n/a'}{why} "
+                 f"— stall verdict [{verdict}]")
     only = [k[0] for k in (set(ma) ^ set(mb))]
     if only:
         L.append(f"  (not in both runs, skipped: {sorted(only)})")
@@ -1915,6 +2037,18 @@ def render_watch(path) -> tuple[str, bool]:
                 f"hbm {_fmt(rf.get('hbm_gbps'), 2)} GB/s "
                 f"(util {_fmt(rf.get('hbm_util'), 4)})  "
                 f"flops/iter {_fmt(rf.get('flops_per_iter'))}")
+        fo = live.get("forensics")
+        if fo:
+            # wheel-forensics tile (obs/diagnose.py): the current
+            # verdict + top culprit slot/scenario, straight off the
+            # live plane (doc/forensics.md)
+            L.append(
+                f"forensics {fo.get('verdict', '?')}: "
+                f"top slot {fo.get('top_slot')} "
+                f"(mass {_fmt(fo.get('top_slot_mass'))})  "
+                f"top scen {fo.get('top_scen')} "
+                f"(share {_fmt(fo.get('top_scen_share'), 3)})  "
+                f"samples {fo.get('samples', 0)}")
         for sp in live.get("spokes", ()):
             flags = []
             if sp.get("alive") is False:
@@ -2001,6 +2135,21 @@ def watch(path, interval=2.0, refreshes=None) -> int:
 
 # ---------------- CLI ----------------
 
+def _json_sanitize(o):
+    """Non-finite floats → None, recursively. Default ``json.dumps``
+    serializes them as bare ``NaN``/``Infinity`` — a JavaScript
+    extension, not JSON, so strict downstream parsers reject the whole
+    document. Applied at the ``--json`` emit boundary (pinned by a
+    ``parse_constant``-raising round-trip test)."""
+    if isinstance(o, float):
+        return o if math.isfinite(o) else None
+    if isinstance(o, dict):
+        return {k: _json_sanitize(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_json_sanitize(v) for v in o]
+    return o
+
+
 def make_parser():
     p = argparse.ArgumentParser(
         prog="python -m mpisppy_tpu analyze",
@@ -2057,7 +2206,7 @@ def main(argv=None) -> int:
                 print(f"analyze: {e}")
                 return 2
             if args.as_json:
-                print(json.dumps(
+                print(json.dumps(_json_sanitize(
                     {"a": {str(k[0]): v
                            for k, v in comparison_metrics(a).items()},
                      "b": {str(k[0]): v
@@ -2072,9 +2221,11 @@ def main(argv=None) -> int:
                              "b": aph_summary(b)},
                      "roofline": {"a": roofline_summary(a),
                                   "b": roofline_summary(b)},
+                     "forensics": {"a": forensics_summary(a),
+                                   "b": forensics_summary(b)},
                      "truncated": {"a": truncated(a),
                                    "b": truncated(b)},
-                     "verdict": "PASS" if passed else "REGRESSION"}))
+                     "verdict": "PASS" if passed else "REGRESSION"})))
             else:
                 print(text)
             return 0 if passed else 3
@@ -2083,7 +2234,7 @@ def main(argv=None) -> int:
             return 2
         run = load_run(args.dirs[0])
         if args.as_json:
-            print(json.dumps({
+            print(json.dumps(_json_sanitize({
                 "run_id": run.header.get("run_id"),
                 "schema": run.schema,
                 "phase_breakdown": phase_breakdown(run),
@@ -2102,13 +2253,14 @@ def main(argv=None) -> int:
                 "checkpoint": checkpoint_summary(run),
                 "serving": serving_summary(run),
                 "faults": fault_summary(run),
+                "forensics": forensics_summary(run),
                 "lint": lint_summary(run),
                 "bound_flow": (bf := bound_flow_summary(run)),
                 "invariants": [
                     {"name": n, "ok": ok, "detail": d, "severity": sv}
                     for n, ok, d, sv in invariant_checks(
                         run, bound_flow=bf)],
-            }))
+            })))
         else:
             print(render_report(run))
         return 0

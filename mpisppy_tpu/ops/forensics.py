@@ -1,0 +1,208 @@
+"""Wheel forensics: the per-iteration convergence-attribution reduction.
+
+The hub reduces all convergence signal to ONE scalar (``conv`` in
+``core/ph.py``) — per-scenario and per-nonant-slot attribution, which
+the reference exposes ad hoc via WW fixer streaks and xbar prints, is
+lost on the device. This module computes the attribution ON the device
+as one jitted reduction over the sharded ``(S, K)`` hub state and packs
+everything into a single small vector, so the host pays exactly one
+extra transfer per SAMPLED iteration (riding the already-synced gate,
+``residual_summary``'s license) and ``ph.gate_syncs`` stays O(1).
+
+Per sample (every ``forensics_interval`` iterations, telemetry on):
+
+- **slot mass** ``m_k = Σ_s p_s · |x_sk − x̄_sk|`` — the prob-weighted
+  disagreement carried by nonant slot k. Decomposes the convergence
+  scalar exactly: ``conv = Σ_k m_k / K``. Top-k slots by mass are the
+  culprit slots.
+- **scenario primal share** ``p_s · Σ_k |x_sk − x̄_sk| / Σ`` and
+  **scenario dual share** ``p_s · Σ_k |ΔW_sk| / Σ`` — which scenarios
+  carry the residual. Mesh pads (zero-probability rows) score −1 and
+  can never win a top-k slot over a real scenario.
+- **W-oscillation score** — per-slot EMA of the prob-weighted
+  sign-flip fraction of ΔW against the previous sample's ΔW. A slot
+  whose multipliers flip sign sample after sample is bouncing around
+  the consensus value: the classic rho-too-large signature.
+- **rho health** — per-slot log10 of primal mass vs dual mass
+  ``(m_k + ε) / (Σ_s p_s|ΔW_sk| + ε)``. Large positive: primal
+  residual dominates (rho too small); large negative: dual churn
+  dominates (rho too large). The mean drives the diagnosis engine's
+  rho advice.
+- **xbar movement** — mean per-slot |x̄ − x̄_prev|, the inner-movement
+  half of the bound-gap decomposition (``obs/diagnose.py`` joins it
+  with the hub's outer-bound trajectory and the bound-flow ledger).
+
+The carried :class:`ForensicState` (prev W, prev ΔW, flip EMA, prev
+x̄-by-slot, sample count) lives on the device next to the hub state;
+dual/oscillation stats are validity-gated by the sample count so the
+first samples never report garbage deltas. Everything here except
+:func:`unpack` is jit-traced; :func:`unpack` performs the ONE designed
+host fetch. See doc/forensics.md for the stat/verdict tables and the
+gate-sync cost argument.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# top-k width for both the slot and scenario leaderboards (callers
+# clamp to the actual K / S at trace time — the packed layout is
+# static per (kk, ks))
+TOPK = 8
+# EMA decay for the per-slot sign-flip fraction: ~2-sample memory, so
+# a transient flip washes out while a persistent oscillation saturates
+FLIP_DECAY = 0.5
+_EPS = 1e-12
+_HDR = 8          # header scalars in the packed vector
+
+
+class ForensicState(NamedTuple):
+    """Device-resident carry between forensic samples."""
+
+    prev_w: jax.Array      # (S, K) W at the previous sample
+    prev_dw: jax.Array     # (S, K) ΔW of the previous sample
+    flip_ema: jax.Array    # (K,)  EMA'd sign-flip fraction per slot
+    prev_xbar: jax.Array   # (K,)  prob-collapsed x̄ at previous sample
+    samples: jax.Array     # ()    completed samples (validity gate)
+
+
+def init_state(S: int, K: int, dtype=jnp.float64) -> ForensicState:
+    z = jnp.zeros
+    return ForensicState(z((S, K), dtype), z((S, K), dtype),
+                         z((K,), dtype), z((K,), dtype),
+                         jnp.zeros((), jnp.int32))
+
+
+def packed_size(kk: int, ks: int) -> int:
+    """Length of the packed stats vector for top-``kk`` slots and
+    top-``ks`` scenarios: 8 header scalars + three slot (id, value)
+    blocks + two scenario (id, value) blocks."""
+    return _HDR + 6 * kk + 4 * ks
+
+
+@partial(jax.jit, static_argnames=("kk", "ks"))
+def forensic_reduce(state: ForensicState, xn, xbar, w, prob, rho, *,
+                    kk: int, ks: int):
+    """One forensic sample over the hub state: returns
+    ``(new_state, packed)`` where ``packed`` is the flat stats vector
+    :func:`unpack` decodes. Pure reductions + two ``top_k`` calls —
+    O(S·K) work, a rounding error next to one subproblem solve — and
+    NO host interaction: the caller fetches ``packed`` at the gate."""
+    dtype = xn.dtype
+    xbar_full = jnp.broadcast_to(xbar, xn.shape)
+    adev = jnp.abs(xn - xbar_full)                    # (S, K)
+    slot_mass = prob @ adev                           # (K,)
+    pri = prob * jnp.sum(adev, axis=1)                # (S,)
+    pri_total = jnp.sum(pri)
+    K = xn.shape[1]
+    conv = pri_total / K
+
+    # dual movement since the previous sample (valid from sample 2;
+    # sign flips need the previous delta too, so valid from sample 3)
+    dw = w - state.prev_w
+    valid_dw = (state.samples >= 1).astype(dtype)
+    valid_flip = (state.samples >= 2).astype(dtype)
+    dwa = jnp.abs(dw)
+    dua_slot = (prob @ dwa) * valid_dw                # (K,)
+    dua = prob * jnp.sum(dwa, axis=1) * valid_dw      # (S,)
+    dua_total = jnp.sum(dua)
+
+    flip = (jnp.sign(dw) * jnp.sign(state.prev_dw) < 0).astype(dtype)
+    flip_frac = (prob @ flip) * valid_flip            # (K,)
+    flip_ema = FLIP_DECAY * state.flip_ema \
+        + (1.0 - FLIP_DECAY) * flip_frac
+    flip_ema = flip_ema * valid_flip
+
+    # rho health: signed log-ratio of primal vs dual mass per slot
+    log_ratio = jnp.log10((slot_mass + _EPS) / (dua_slot + _EPS))
+    log_ratio = jnp.clip(log_ratio, -6.0, 6.0) * valid_dw
+    ratio_mean = jnp.mean(log_ratio)
+
+    # inner-movement half of the bound-gap decomposition: how much the
+    # consensus point itself moved since the previous sample
+    xbar_slot = prob @ xbar_full                      # (K,)
+    xbar_move = jnp.mean(jnp.abs(xbar_slot - state.prev_xbar)) \
+        * valid_dw
+    rhobar_mean = jnp.mean(prob @ rho)
+
+    # leaderboards (static widths; pads excluded by the prob mask —
+    # a pad's score of −1 never beats a real scenario's share ≥ 0)
+    sm_v, sm_i = jax.lax.top_k(slot_mass, kk)
+    os_v, os_i = jax.lax.top_k(flip_ema, kk)
+    rh_v, rh_i = jax.lax.top_k(jnp.abs(log_ratio), kk)
+    real = prob > 0
+    pri_share = jnp.where(real, pri / (pri_total + _EPS), -1.0)
+    dua_share = jnp.where(real, dua / (dua_total + _EPS), -1.0)
+    ps_v, ps_i = jax.lax.top_k(pri_share, ks)
+    ds_v, ds_i = jax.lax.top_k(dua_share, ks)
+
+    samples = state.samples + 1
+    f = lambda a: a.astype(dtype).ravel()
+    packed = jnp.concatenate([
+        f(samples[None]), f(conv[None]), f(pri_total[None]),
+        f(dua_total[None]),
+        f(jnp.mean(flip_ema)[None]), f(ratio_mean[None]),
+        f(xbar_move[None]), f(rhobar_mean[None]),
+        f(sm_i), f(sm_v),
+        f(os_i), f(os_v),
+        f(rh_i), f(jnp.take(log_ratio, rh_i)),   # signed, abs-ranked
+        f(ps_i), f(ps_v),
+        f(ds_i), f(ds_v),
+    ])
+    new_state = ForensicState(w, dw, flip_ema, xbar_slot, samples)
+    return new_state, packed
+
+
+def unpack(packed, kk: int, ks: int) -> dict:
+    """Decode one packed stats vector into the plain host dict the
+    diagnosis engine / telemetry record consume. THE designed fetch:
+    by record-emission time the iteration already synced ``conv``
+    (``residual_summary``'s license), so this transfers
+    ``packed_size(kk, ks)`` floats without adding a pipeline stall."""
+    # the designed per-sample fetch (allowlisted gate site — see
+    # tools/lint engine SYNC_ALLOW and doc/forensics.md)
+    v = np.asarray(packed, dtype=np.float64)
+    if v.shape != (packed_size(kk, ks),):
+        raise ValueError(
+            f"packed forensics vector has shape {v.shape}, expected "
+            f"({packed_size(kk, ks)},) for kk={kk} ks={ks}")
+    o = _HDR
+    blocks = {}
+    for name in ("slots", "osc_slots", "rho_slots"):
+        ids, vals = v[o:o + kk], v[o + kk:o + 2 * kk]
+        blocks[name] = (ids, vals)
+        o += 2 * kk
+    for name in ("scens_pri", "scens_dua"):
+        ids, vals = v[o:o + ks], v[o + ks:o + 2 * ks]
+        blocks[name] = (ids, vals)
+        o += 2 * ks
+
+    def pairs(name, drop_below=None):
+        ids, vals = blocks[name]
+        out = []
+        for i, x in zip(ids, vals):
+            if drop_below is not None and x < drop_below:
+                continue       # masked pad row (score −1), never real
+            out.append([int(i), float(x)])
+        return out
+
+    return {
+        "samples": int(v[0]),
+        "conv": float(v[1]),
+        "pri_total": float(v[2]),
+        "dua_total": float(v[3]),
+        "osc_mean": float(v[4]),
+        "rho_log_ratio_mean": float(v[5]),
+        "xbar_move": float(v[6]),
+        "rho_mean": float(v[7]),
+        "top_slots": pairs("slots"),
+        "osc_slots": pairs("osc_slots"),
+        "rho_slots": pairs("rho_slots"),
+        "scen_pri_shares": pairs("scens_pri", drop_below=0.0),
+        "scen_dua_shares": pairs("scens_dua", drop_below=0.0),
+    }

@@ -41,6 +41,7 @@ import time
 import numpy as np
 
 from .. import global_toc, obs
+from ..obs import diagnose as _obs_diagnose
 from ..ckpt.bundle import (atomic_write_json, config_fingerprint,
                            latest_bundle)
 from ..utils.config import ServeConfig
@@ -799,6 +800,17 @@ class ServeService:
                  if not (preempted or deadline_missed) else None,
                  "conv": final_conv,
                  "seconds": seconds}
+        # per-wheel forensics (obs/diagnose.py): the wheel's diagnosis
+        # verdict + top culprits ride the request stamp — a DNF'd
+        # serve request names its stall instead of just timing out
+        # (lock-free plain-dict read; the /metrics gauges ride the
+        # registry automatically)
+        snap = _obs_diagnose.snapshot()
+        if snap:
+            stamp["forensics"] = {
+                "verdict": snap.get("verdict"),
+                "top_slot": snap.get("top_slot"),
+                "top_scen_share": snap.get("top_scen_share")}
         return {"stamp": stamp, "results": results,
                 "preempted": preempted,
                 "deadline_missed": deadline_missed,

@@ -156,6 +156,12 @@ HOT_LOOP_DEFAULT = (
     # aph.gate_syncs contract
     "mpisppy_tpu/core/aph.py",
     "mpisppy_tpu/ops/dispatch.py",
+    # wheel forensics (ISSUE 19, doc/forensics.md): the attribution
+    # reduction runs against the live hub state every sampled
+    # iteration — its ONE designed fetch (unpack) carries a reasoned
+    # suppression; anything else syncing here breaks the O(1)
+    # ph.gate_syncs contract exactly like a readback in core/ph
+    "mpisppy_tpu/ops/forensics.py",
 )
 
 # modules that document themselves jax-free (CHANGES/doc claims backed
@@ -175,6 +181,10 @@ JAX_FREE_DEFAULT = (
     "mpisppy_tpu/serve/queue.py",
     "mpisppy_tpu/serve/batch.py",
     "mpisppy_tpu/serve/http.py",
+    # the diagnosis engine (ISSUE 19, doc/forensics.md): the hub
+    # status plane, bench's signal handler, and serve read its
+    # snapshots as plain dict lookups — it must never pull in jax
+    "mpisppy_tpu/obs/diagnose.py",
 )
 
 # SYNC001's allowlisted gate sites: functions in hot-loop modules that
@@ -218,6 +228,11 @@ SYNC_ALLOW_DEFAULT = {
         "PHBase.evaluate_incumbent_pool":
             "pool staging + the ONE stacked verdict D2H per round "
             "(O(1) asserted by tests/test_incumbent.py)",
+        "PHBase._forensic_sample":
+            "gate-time diagnostics: fetches the packed forensic "
+            "vector AFTER the iteration gate synced conv "
+            "(residual_summary's license; O(1) asserted by "
+            "tests/test_forensics.py)",
     },
     "mpisppy_tpu/core/aph.py": {
         "APH.aph_state_arrays":
@@ -268,6 +283,13 @@ SYNC_ALLOW_DEFAULT = {
         "make_mesh": "mesh construction, once per engine",
         "pad_batch_for_mesh":
             "zero-probability padding at engine build, setup-time",
+    },
+    "mpisppy_tpu/ops/forensics.py": {
+        "unpack":
+            "decodes the ALREADY-FETCHED packed stats vector: its one "
+            "np.asarray is the designed per-sample fetch at the "
+            "already-synced gate (doc/forensics.md), every float() "
+            "after it is host math on the numpy copy",
     },
     "mpisppy_tpu/ops/shrink.py": {
         "build_plan":

@@ -43,6 +43,14 @@ and a finite measured MFU (doc/roofline.md), and the disabled-mode
 zero-allocation test re-runs so the capture layer's zero-cost-when-off
 contract is gated, not just tested.
 
+Since ISSUE 19 a forensics smoke rides after the profile smoke
+(``--skip-forensics-smoke`` opts out): the fresh bench dir must carry
+forensic samples and judge HEALTHY through analyze's forensics
+section, and a deliberately rho-starved farmer wheel (rho 1e-9 — the
+outer bound freezes over a real gap) must judge non-HEALTHY with an
+evidence-carrying verdict (doc/forensics.md) — the diagnosis engine
+is gated from both the false-positive and the false-negative side.
+
 Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
 3 REGRESSION.
 
@@ -386,6 +394,65 @@ def run_profile_smoke(fresh: str) -> int:
     return 0
 
 
+def run_forensics_smoke(fresh: str) -> int:
+    """The ISSUE 19 CI rider: the diagnosis engine's verdict contract,
+    gated from BOTH sides. The fresh golden-recipe bench (the dir the
+    compare stage just judged) must carry forensic samples AND judge
+    HEALTHY — a threshold drift that starts flagging a converging
+    wheel fails here. Then a deliberately rho-starved farmer wheel
+    (rho 1e-9: W barely moves, the Lagrangian outer bound freezes
+    while a real gap remains) must judge non-HEALTHY with
+    evidence-carrying verdicts — a rule that stops firing on a
+    genuinely stuck wheel also fails here."""
+    from mpisppy_tpu.obs.analyze import load_run, forensics_summary
+    fz = forensics_summary(load_run(fresh))
+    if fz is None or not fz.get("samples"):
+        print("regression_gate: FORENSICS SMOKE FAILURE — the fresh "
+              "bench produced no forensic samples (ops/forensics -> "
+              "iteration_record wiring broken)")
+        return 3
+    if fz["verdict"] != "HEALTHY":
+        why = fz["verdicts"][0]["summary"] if fz["verdicts"] else "?"
+        print("regression_gate: FORENSICS SMOKE REGRESSION — the "
+              f"golden-recipe bench judged {fz['verdict']} ({why}); "
+              "a converging wheel must judge HEALTHY (rule threshold "
+              "drift, doc/forensics.md)")
+        return 3
+    starved = os.path.join(fresh, "forensics_starved")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)
+    cmd = [sys.executable, "-m", "mpisppy_tpu", "farmer",
+           "--num-scens", "3", "--max-iterations", "14",
+           "--convthresh", "-1", "--subproblem-max-iter", "1500",
+           "--with-lagrangian", "--with-xhatshuffle",
+           "--rel-gap", "1e-6", "--default-rho", "1e-9",
+           "--forensics-interval", "1", "--telemetry-dir", starved]
+    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=600)
+    if r.returncode != 0:
+        print("regression_gate: FORENSICS SMOKE FAILURE — the "
+              f"rho-starved wheel itself failed (rc {r.returncode})")
+        return 3
+    sz = forensics_summary(load_run(starved))
+    if sz is None or sz["verdict"] == "HEALTHY":
+        print("regression_gate: FORENSICS SMOKE REGRESSION — the "
+              "rho-starved wheel judged "
+              f"{sz['verdict'] if sz else 'no-data'}; a frozen outer "
+              "bound over a 7% gap must produce a non-HEALTHY verdict "
+              "(diagnosis rules went blind, doc/forensics.md)")
+        return 3
+    top = sz["verdicts"][0]
+    if not top.get("evidence"):
+        print("regression_gate: FORENSICS SMOKE REGRESSION — verdict "
+              f"{top['verdict']} carries no evidence dict (the "
+              "diagnosis contract is named AND evidenced)")
+        return 3
+    print(f"regression_gate: forensics smoke ok (golden recipe "
+          f"HEALTHY over {fz['samples']} samples; starved wheel "
+          f"{sz['verdict']}: {top['summary']})")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="tier-1 perf regression gate "
@@ -422,6 +489,12 @@ def main(argv=None) -> int:
                         "(doc/roofline.md: compile ledger + finite "
                         "MFU + disabled-mode overhead); the bench + "
                         "compare gate still runs")
+    p.add_argument("--skip-forensics-smoke", action="store_true",
+                   help="skip the diagnosis-engine smoke stage "
+                        "(doc/forensics.md: golden recipe HEALTHY, "
+                        "rho-starved wheel non-HEALTHY with "
+                        "evidence); the bench + compare gate still "
+                        "runs")
     args = p.parse_args(argv)
 
     if args.update_golden:
@@ -493,6 +566,13 @@ def main(argv=None) -> int:
             # profile smoke (ISSUE 18): the measured-roofline capture
             # contract judged on the fresh dir the compare just used
             rc = run_profile_smoke(fresh)
+            if rc != 0:
+                return rc
+        if not args.skip_forensics_smoke:
+            # forensics smoke (ISSUE 19): the diagnosis-engine verdict
+            # contract — the fresh dir must judge HEALTHY, a
+            # rho-starved wheel must judge non-HEALTHY with evidence
+            rc = run_forensics_smoke(fresh)
             if rc != 0:
                 return rc
         if not args.skip_stream_smoke:
