@@ -225,6 +225,35 @@ def resolve_bundle(path: str) -> str:
     return b
 
 
+def file_sha256(path: str, chunk: int = 1 << 16) -> str:
+    """Streaming sha256 of one file (chunked — bundle members can be
+    large and the migration transfer verifies them incrementally)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def transfer_manifest(bundle_dir: str) -> dict:
+    """``{name: {"size", "sha256"}}`` over every member of one bundle
+    dir — the wire-integrity half of a live migration offer
+    (serve/migrate): the receiver verifies each streamed member
+    against this BEFORE the load_bundle semantic gates run, so a torn
+    transfer is refused at the byte layer with a reasoned abort
+    instead of surfacing as a mysterious npz parse error."""
+    out = {}
+    for fn in sorted(os.listdir(bundle_dir)):
+        fp = os.path.join(bundle_dir, fn)
+        if os.path.isfile(fp):
+            out[fn] = {"size": os.path.getsize(fp),
+                       "sha256": file_sha256(fp)}
+    return out
+
+
 def load_bundle(path: str, fingerprint: str | None = None):
     """Read + validate one bundle. Returns ``(manifest, hub_arrays,
     spoke_paths)`` where ``hub_arrays`` passed

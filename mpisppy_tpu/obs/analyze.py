@@ -879,6 +879,31 @@ def serving_summary(run: Run) -> dict | None:
         if h:
             occ = h
             break
+    # migration ledger (doc/serving.md): every offer settles in the
+    # SAME process as exactly one of handed_off / aborted.<reason>, so
+    # summed-across-roles totals must reconcile — a gap means an offer
+    # path returned without booking its outcome
+    mig_offered = int(tot.get("serve.migrate.offered", 0))
+    mig_aborted = {k[len("serve.migrate.aborted."):]: int(v)
+                   for k, v in tot.items()
+                   if k.startswith("serve.migrate.aborted.")}
+    mig_rejected = {k[len("serve.migrate.rejected."):]: int(v)
+                    for k, v in tot.items()
+                    if k.startswith("serve.migrate.rejected.")}
+    migration = None
+    if mig_offered or mig_aborted or tot.get("serve.migrate.committed"):
+        handed = int(tot.get("serve.migrate.handed_off", 0))
+        migration = {
+            "offered": mig_offered,
+            "handed_off": handed,
+            "accepted": int(tot.get("serve.migrate.accepted", 0)),
+            "committed": int(tot.get("serve.migrate.committed", 0)),
+            "completed": int(tot.get("serve.migrate.completed", 0)),
+            "aborted": mig_aborted,
+            "rejected": mig_rejected,
+            "reconciled": mig_offered == handed
+            + sum(mig_aborted.values()),
+        }
     return {
         "admitted": int(tot.get("serve.requests.admitted", 0)),
         "completed": int(tot.get("serve.requests.completed", 0)),
@@ -900,6 +925,9 @@ def serving_summary(run: Run) -> dict | None:
         "batch_occupancy": occ,
         "per_bucket_compiles": per_bucket,
         "service_preempted": bool(int(tot.get("serve.preempted", 0))),
+        "drained": bool(int(tot.get("serve.drained", 0))),
+        "quarantined": int(tot.get("serve.request.quarantined", 0)),
+        "migration": migration,
     }
 
 
@@ -1438,6 +1466,28 @@ def render_report(run: Run) -> str:
         if sv["service_preempted"]:
             L.append("SERVICE PREEMPTED: in-flight wheels "
                      "checkpointed; requests resume at next start")
+        if sv.get("quarantined"):
+            L.append(f"QUARANTINED: {sv['quarantined']} request(s) "
+                     "failed after exhausting --max-recoveries "
+                     "(poison pill suspected)")
+        mig = sv.get("migration")
+        if mig is not None:
+            L.append(f"migration: {mig['offered']} offered  "
+                     f"{mig['handed_off']} handed off  "
+                     f"{mig['committed']} committed  "
+                     f"{mig['completed']} completed"
+                     + ("  [drained]" if sv.get("drained") else ""))
+            if mig["aborted"]:
+                L.append("  aborted: " + "  ".join(
+                    f"{k}={v}" for k, v in sorted(mig["aborted"].items())))
+            if mig["rejected"]:
+                L.append("  rejected by receiver: " + "  ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(mig["rejected"].items())))
+            if not mig["reconciled"]:
+                L.append("  LEDGER MISMATCH: offered != handed_off + "
+                         "aborted — an offer path returned without "
+                         "booking its outcome (doc/serving.md)")
         L.append("")
 
     shr = shrink_summary(run)

@@ -23,18 +23,33 @@ Endpoints:
   ``Hub.status_snapshot`` with its ``request_tag``), warm-cache
   anatomy.
 - ``POST /shutdown`` — graceful drain (finish active wheels, keep
-  queued requests durable); ``/healthz`` — liveness.
+  queued requests durable); ``/healthz`` — liveness (+ ``draining``).
+- ``POST /drain`` — drain-for-deploy: migrate everything out to a live
+  peer, then refuse admissions with ``Retry-After`` + a peer hint.
+- ``POST /migrate/offer`` / ``PUT /migrate/bundle/<id>?file=<name>`` /
+  ``POST /migrate/commit`` — the receiver half of a live wheel handoff
+  (serve/migrate): offer opens a staging dir, PUTs stream bundle
+  members with sha256 verification, commit gates the bundle through
+  ``load_bundle`` and admits the request via force-push recovery.
+  Refusals are reasoned 4xx bodies the donor books as
+  ``serve.migrate.aborted.<reason>``.
+
+``429`` and ``503`` responses carry ``Retry-After`` so clients back
+off instead of hammering; a draining 503 adds ``"peer"`` — the live
+host that will take the work.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import obs
 from ..obs.live import render_prometheus
 from .batch import BadRequest
+from .migrate import MigrationError
 from .queue import QueueFull
 
 _JSON = "application/json; charset=utf-8"
@@ -51,21 +66,30 @@ class _ServeHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):     # the screen trace is the wheel's
         pass
 
-    def _reply(self, code, ctype, body):
+    def _reply(self, code, ctype, body, headers=None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
+    @staticmethod
+    def _unpack(out):
+        # routes return (code, ctype, body) or + an extra-headers dict
+        if len(out) == 4:
+            return out
+        code, ctype, body = out
+        return code, ctype, body, None
+
     def do_GET(self):
         try:
-            code, ctype, body = self.server._get(
-                self.path.split("?", 1)[0])
+            out = self._unpack(self.server._get(
+                self.path.split("?", 1)[0]))
         except Exception as e:      # introspection must never crash
-            code, ctype = 500, _TEXT
-            body = f"serve error: {e!r}\n".encode()
-        self._reply(code, ctype, body)
+            out = (500, _TEXT, f"serve error: {e!r}\n".encode(), None)
+        self._reply(*out)
 
     def do_POST(self):
         try:
@@ -73,14 +97,32 @@ class _ServeHandler(BaseHTTPRequestHandler):
             if n > _MAX_BODY:
                 raise BadRequest(f"body over {_MAX_BODY} bytes")
             raw = self.rfile.read(n) if n else b""
-            code, ctype, body = self.server._post(
-                self.path.split("?", 1)[0], raw)
+            out = self._unpack(self.server._post(
+                self.path.split("?", 1)[0], raw))
         except BadRequest as e:
-            code, ctype, body = _json_body(400, {"error": str(e)})
+            out = _json_body(400, {"error": str(e)}) + (None,)
         except Exception as e:
-            code, ctype = 500, _TEXT
-            body = f"serve error: {e!r}\n".encode()
-        self._reply(code, ctype, body)
+            out = (500, _TEXT, f"serve error: {e!r}\n".encode(), None)
+        self._reply(*out)
+
+    def do_PUT(self):
+        """Streaming member upload for a live migration — the body is
+        NOT buffered (bundle members can be arbitrarily large within
+        ``_MAX_BODY``); the receiver hashes it as it lands."""
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n > _MAX_BODY:
+                raise BadRequest(f"body over {_MAX_BODY} bytes")
+            out = self._unpack(self.server._put(
+                self.path, self.rfile, n))
+        except BadRequest as e:
+            out = _json_body(400, {"error": str(e)}) + (None,)
+        except Exception as e:
+            out = (500, _TEXT, f"serve error: {e!r}\n".encode(), None)
+        # a refused streaming PUT may leave unread body bytes on the
+        # socket; close the connection rather than resynchronize
+        self.close_connection = True
+        self._reply(*out)
 
 
 class _ServeHTTPServer(ThreadingHTTPServer):
@@ -114,24 +156,37 @@ class _ServeHTTPServer(ThreadingHTTPServer):
                     render_prometheus(snap, extra_gauges=extra).encode())
         if path in ("/", "/healthz"):
             return _json_body(200, {"ok": True,
-                                    "preempting": svc._preempting})
+                                    "preempting": svc._preempting,
+                                    "draining": getattr(
+                                        svc, "_draining", False)})
         return (404, _TEXT, b"unknown path; try /solve /result/<id> "
                             b"/queue /status /metrics /healthz\n")
 
     def _post(self, path, raw):
         obs.counter_add("serve.http_requests")
         svc = self._service
-        if path == "/solve":
-            if svc._preempting or svc._stop:
-                return _json_body(503, {"error": "service stopping"})
+
+        def _parse():
             try:
-                payload = json.loads(raw.decode("utf-8") or "{}")
+                return json.loads(raw.decode("utf-8") or "{}")
             except ValueError as e:
                 raise BadRequest(f"invalid JSON body: {e}") from None
+
+        if path == "/solve":
+            draining = getattr(svc, "_draining", False)
+            if svc._preempting or svc._stop or draining:
+                body = {"error": "service draining" if draining
+                                 else "service stopping"}
+                peer = svc.peer_hint() if draining else None
+                if peer:
+                    body["peer"] = peer
+                return _json_body(503, body) + ({"Retry-After": "2"},)
+            payload = _parse()
             try:
                 req = svc.submit(payload)
             except QueueFull as e:
-                return _json_body(429, {"error": str(e)})
+                return _json_body(429, {"error": str(e)}) \
+                    + ({"Retry-After": "1"},)
             return _json_body(202, {"request_id": req.id,
                                     "bucket": req.bucket,
                                     "batchable": req.batchable})
@@ -139,7 +194,42 @@ class _ServeHTTPServer(ThreadingHTTPServer):
             if self._on_shutdown is not None:
                 self._on_shutdown()
             return _json_body(200, {"ok": True, "stopping": True})
-        return (404, _TEXT, b"unknown POST path; try /solve /shutdown\n")
+        if path == "/drain":
+            return _json_body(200, svc.drain("http"))
+        if path == "/migrate/offer":
+            try:
+                return _json_body(200, svc.migrate_offer(_parse()))
+            except MigrationError as e:
+                return _json_body(409 if e.reason != "refused" else 400,
+                                  {"error": str(e), "reason": e.reason})
+        if path == "/migrate/commit":
+            try:
+                return _json_body(200, svc.migrate_commit(_parse()))
+            except MigrationError as e:
+                return _json_body(409 if e.reason != "refused" else 400,
+                                  {"error": str(e), "reason": e.reason})
+        return (404, _TEXT, b"unknown POST path; try /solve /shutdown "
+                            b"/drain /migrate/offer /migrate/commit\n")
+
+    def _put(self, path_q, stream, length):
+        """``PUT /migrate/bundle/<id>?file=<name>`` — one streamed
+        bundle member into the migration staging dir."""
+        obs.counter_add("serve.http_requests")
+        svc = self._service
+        path, _, query = path_q.partition("?")
+        if not path.startswith("/migrate/bundle/"):
+            return (404, _TEXT, b"unknown PUT path; try "
+                                b"/migrate/bundle/<id>?file=<name>\n")
+        mid = urllib.parse.unquote(path[len("/migrate/bundle/"):])
+        name = (urllib.parse.parse_qs(query).get("file") or [""])[0]
+        if not mid or not name:
+            raise BadRequest("PUT needs /migrate/bundle/<id>?file=<name>")
+        try:
+            return _json_body(200, svc.migrate_put(mid, name, stream,
+                                                   length))
+        except MigrationError as e:
+            return _json_body(400, {"error": str(e),
+                                    "reason": e.reason})
 
 
 class ServeHTTPServer:

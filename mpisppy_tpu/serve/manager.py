@@ -47,6 +47,9 @@ from ..ckpt.bundle import (atomic_write_json, config_fingerprint,
 from ..utils.config import ServeConfig
 from . import batch as sbatch
 from .cache import WarmCache
+from .migrate import (MigrationClient, MigrationError, MigrationReceiver,
+                      PeerRegistry, read_endpoint,
+                      resolve_interrupted_migration)
 from .queue import AdmissionQueue, Request, RequestStore
 
 _CONSENSUS_FEAS_TOL = 1e-4
@@ -280,6 +283,15 @@ class ServeService:
         self._stop = False
         self._preempting = False
         self._started_unix = None
+        # the fleet half (serve/migrate): peer registry (None = solo
+        # host), the receiver staging machinery, drain state, and the
+        # SIGTERM escalation latch (bundle-and-exit becomes
+        # migrate-then-exit when a live peer exists)
+        self.peers = PeerRegistry(cfg.peers) if cfg.peers else None
+        self.receiver = MigrationReceiver(cfg.state_dir)
+        self._draining = False
+        self._migrate_exit = False
+        self._fault_injector = None   # testing/faults.ServeFaultInjector
 
     # ---- paths ----
     def _ckpt_ns(self, ns: str) -> str:
@@ -303,7 +315,7 @@ class ServeService:
         import shutil
         horizon = time.time() - self.cfg.request_retention
         for r in self.store.load_all():
-            if r.status in ("done", "failed") \
+            if r.status in ("done", "failed", "migrated") \
                     and (r.finished_unix or r.submitted_unix) < horizon:
                 self.store.delete(r.id)
                 shutil.rmtree(self._ckpt_ns(r.id), ignore_errors=True)
@@ -337,27 +349,44 @@ class ServeService:
 
     def stop(self, join_timeout=60.0):
         """Graceful drain: finish active wheels, leave queued requests
-        durable for the next start."""
+        durable for the next start. When exiting under migrate-then-
+        exit (SIGTERM with a live peer) or a deploy drain, whatever
+        never reached a worker hands off record-only — queued work is
+        pure payload, nothing to bundle."""
         self._stop = True
         self.queue.stop()
         for t in self._workers:
             t.join(timeout=join_timeout)
+        if self.peers is not None and (self._migrate_exit
+                                       or self._draining):
+            for r in self.store.load_all():
+                if r.status == "queued":
+                    self._migrate_out(r)
         obs.event("serve.stop", {"preempted": self._preempting})
 
     def preempt(self, source="sigterm"):
         """The preemption notice (SIGTERM): checkpoint every in-flight
         wheel through its hub (forced final bundle), mark the wheel
-        terminated, and stop. In-flight requests persist as
+        terminated, and stop. Solo host: in-flight requests persist as
         ``preempted`` and resume from their bundle at the next start —
-        the serve-level twin of Hub.handle_preemption."""
+        the serve-level twin of Hub.handle_preemption. With a live
+        peer (``--peers``), SIGTERM ESCALATES from bundle-and-exit to
+        migrate-then-exit: each forced bundle hands off to the peer
+        and the request finishes THERE instead of waiting for this
+        host to come back."""
         if self._preempting:
             return
         self._preempting = True
+        self._migrate_exit = (self.peers is not None
+                              and self.peers.any_live())
         obs.counter_add("serve.preempted")
         obs.event("serve.preempt", {"source": source,
-                                    "active": len(self._active_hubs)})
+                                    "active": len(self._active_hubs),
+                                    "migrate_exit": self._migrate_exit})
         global_toc(f"serve: preemption notice ({source}); "
-                   "checkpointing in-flight wheels")
+                   + ("migrating in-flight wheels to a peer"
+                      if self._migrate_exit
+                      else "checkpointing in-flight wheels"))
         self.queue.stop()
         self._stop = True
         with self._hub_lock:
@@ -367,6 +396,48 @@ class ServeService:
                 hub.handle_preemption(source)
             except Exception:     # a torn wheel must not block the rest
                 pass
+
+    def drain(self, source="http") -> dict:
+        """Drain-for-deploy (``POST /drain``): refuse new admissions
+        (503 + ``Retry-After`` + a peer hint), hand queued and
+        in-flight work to a live peer, and finish locally whatever
+        cannot migrate — the service stays up (and keeps answering
+        ``GET /result``) until ``/shutdown``. Idempotent."""
+        if not self._draining:
+            self._draining = True
+            obs.counter_add("serve.drained")
+            obs.event("serve.drain", {"source": source,
+                                      "active": len(self._active_hubs)})
+            global_toc(f"serve: draining ({source}); "
+                       + ("migrating work to peers"
+                          if self.peers is not None else
+                          "no peers configured — finishing work "
+                          "locally"))
+            with self._hub_lock:
+                hubs = list(self._active_hubs.values())
+
+            def _kick(hubs=hubs):
+                # force every active wheel to a bundle at its next
+                # iteration boundary; the wheel exits "preempted" and
+                # its worker hands the request off (or requeues it
+                # no-migrate to finish locally)
+                for hub in hubs:
+                    try:
+                        hub.handle_preemption("drain")
+                    except Exception:
+                        pass
+            threading.Thread(target=_kick, name="serve-drain-kick",
+                             daemon=True).start()
+        return {"ok": True, "draining": True,
+                "queued": len(self.queue),
+                "active": len(self._active_hubs),
+                "peer": self.peer_hint()}
+
+    def peer_hint(self) -> str | None:
+        """The live peer a refused client should try (rides draining
+        503 bodies)."""
+        return self.peers.first_live() if self.peers is not None \
+            else None
 
     # ---- admission (the HTTP plane calls these) ----
     def submit(self, payload: dict) -> Request:
@@ -420,6 +491,8 @@ class ServeService:
                 "started_unix": self._started_unix,
                 "state_dir": self.cfg.state_dir,
                 "preempting": self._preempting,
+                "draining": self._draining,
+                "peers": self.peers.peers if self.peers else [],
                 "queue_depth": len(self.queue),
                 "requests": counts,
                 "wheels": wheels,
@@ -430,11 +503,245 @@ class ServeService:
             reqs = [r.summary() for r in self._requests.values()]
         return {"queued": self.queue.snapshot(), "requests": reqs}
 
+    # ---- migration: the donor half (serve/migrate + doc/serving.md) ----
+    def _resume_bundle_for(self, r) -> str | None:
+        """The newest resumable bundle for one request — the same
+        lookup startup recovery runs (chain requests fall back to
+        their newest committed step's namespace)."""
+        bundle = latest_bundle(self._ckpt_ns(r.id))
+        if bundle is None and "chain" in r.payload:
+            step = len(r.chain_results)
+            for j in (step, step - 1):
+                if j < 0:
+                    break
+                bundle = latest_bundle(
+                    self._ckpt_ns(f"{r.id}-step{j}"))
+                if bundle is not None:
+                    break
+        return bundle
+
+    def _migrate_out(self, req, gid=None) -> bool:
+        """Hand one request to a live peer. Two-phase: the durable
+        record flips to ``migrating`` BEFORE the first wire byte and
+        settles ``migrated`` only after the receiver's commit ack —
+        any failure books ``serve.migrate.aborted.<reason>``, restores
+        the previous status and returns False so the caller finishes
+        the wheel itself. The ledger invariant: every ``offered``
+        settles as exactly one of ``handed_off`` / ``aborted.*``."""
+        if self.peers is None:
+            return False
+        obs.counter_add("serve.migrate.offered")
+        peer = self.peers.first_live()
+        if peer is None:
+            reason = "no_live_peer"
+            obs.counter_add(f"serve.migrate.aborted.{reason}")
+            obs.event("serve.migrate_abort",
+                      {"id": req.id, "reason": reason})
+            return False
+        # group bundles do not transfer (their fingerprint is stack-
+        # specific — config_fingerprint over the member ids): group
+        # members hand off record-only and restart cold on the peer
+        bundle = self._resume_bundle_for(req) if gid is None else None
+        prev_status = req.status
+        req.status = "migrating"
+        req.peer = peer
+        self.store.save(req)
+        inj = self._fault_injector
+        client = MigrationClient(
+            peer, deadline=self.cfg.migrate_deadline,
+            retries=self.cfg.migrate_retries,
+            tear_hook=inj.on_transfer if inj is not None else None)
+        rec = req.to_json()
+        rec["status"] = "queued"     # the receiver admits it fresh
+        rec["group"] = None
+        try:
+            client.migrate(rec, bundle)
+        except MigrationError as e:
+            obs.counter_add(f"serve.migrate.aborted.{e.reason}")
+            obs.event("serve.migrate_abort",
+                      {"id": req.id, "peer": peer, "reason": e.reason,
+                       "detail": str(e)})
+            global_toc(f"serve: migration of {req.id} -> {peer} "
+                       f"aborted ({e.reason}); finishing locally")
+            req.status = prev_status
+            req.peer = None
+            self.store.save(req)
+            return False
+        req.finished_unix = time.time()
+        req.status = "migrated"
+        self.store.save(req)
+        obs.counter_add("serve.migrate.handed_off")
+        obs.event("serve.migrate", {"id": req.id, "peer": peer,
+                                    "bundle": bool(bundle)})
+        global_toc(f"serve: migrated {req.id} -> {peer}"
+                   + (" (with bundle)" if bundle else " (record only)"))
+        return True
+
+    def _park_or_migrate(self, r, gid=None):
+        """A wheel interrupted by preemption or drain either hands its
+        request to a peer, requeues it to finish locally (drain with
+        no taker — the degradation guarantee), or parks it
+        ``preempted`` for this host's own restart."""
+        if (self._draining or self._migrate_exit) \
+                and not getattr(r, "_no_migrate", False) \
+                and self._migrate_out(r, gid=gid):
+            return
+        if self._draining and not self._preempting:
+            r._no_migrate = True
+            r.group = None
+            r.no_batch = True
+            r.status = "queued"
+            self.store.save(r)
+            self.queue.push(r, front=True, force=True)
+            return
+        r.status = "preempted"
+        self.store.save(r)
+        obs.counter_add("serve.requests.preempted")
+
+    # ---- migration: the receiver half (the HTTP plane calls these) ----
+    def migrate_offer(self, payload: dict) -> dict:
+        try:
+            if self._preempting or self._stop or self._draining:
+                raise MigrationError("refused",
+                                     "receiver is draining/stopping")
+            inj = self._fault_injector
+            if inj is not None:
+                verdict, sleep_s = inj.on_offer()
+                if sleep_s:
+                    time.sleep(sleep_s)
+                if verdict == "refuse":
+                    raise MigrationError("refused",
+                                         "fault plan: refuse_peer")
+            rid = ((payload or {}).get("request") or {}).get("id")
+            if rid and self.store.load(rid) is not None:
+                # idempotent by request id: an earlier handoff of this
+                # request already landed — ack without re-staging
+                return {"ok": True, "already": True, "request_id": rid}
+            out = self.receiver.offer(payload)
+            obs.counter_add("serve.migrate.accepted")
+            return {"ok": True, **out}
+        except MigrationError as e:
+            obs.counter_add(f"serve.migrate.rejected.{e.reason}")
+            raise
+
+    def migrate_put(self, mid: str, name: str, stream, length) -> dict:
+        try:
+            return self.receiver.put_member(mid, name, stream,
+                                            int(length))
+        except MigrationError as e:
+            obs.counter_add(f"serve.migrate.rejected.{e.reason}")
+            raise
+
+    def migrate_commit(self, payload: dict) -> dict:
+        try:
+            rid = (payload or {}).get("request_id")
+            if rid and self.store.load(rid) is not None:
+                # the donor's ack got lost and it re-committed (or
+                # re-offered): the request is already durable here —
+                # ack idempotently, never admit twice
+                mid0 = (payload or {}).get("migration_id")
+                if mid0:
+                    self.receiver.abort(mid0)
+                return {"ok": True, "already": True, "request_id": rid}
+            mid = (payload or {}).get("migration_id")
+            if not mid:
+                raise MigrationError("refused",
+                                     "commit needs migration_id")
+            rec0 = self.receiver.offer_record(mid)
+            # the solo-request checkpoint fingerprint is (bucket,
+            # request id) — both ride the record, so the recomputed
+            # value is bit-identical on any host and the staged bundle
+            # passes the SAME load_bundle gate a local resume runs
+            fingerprint = config_fingerprint(
+                {"bucket": rec0.get("bucket"), "request": rec0["id"]})
+            rec, bundle = self.receiver.finalize(
+                mid, self._ckpt_ns(rec0["id"]), fingerprint)
+            req = Request.from_json(rec)
+            req.status = "queued"
+            req.group = None
+            req.peer = None
+            req.migrated_from = str(mid)
+            req.resume_from = bundle
+            req.resumed = bool(bundle) or req.resumed
+            self.store.save(req)
+            with self._req_lock:
+                self._requests[req.id] = req
+            self.queue.push(req, front=True, force=True)
+            obs.counter_add("serve.migrate.committed")
+            obs.event("serve.migrate_in",
+                      {"id": req.id, "migration_id": mid,
+                       "bundle": bool(bundle)})
+            global_toc(f"serve: migrated-in {req.id}"
+                       + (" (with bundle)" if bundle
+                          else " (record only)"))
+            return {"ok": True, "request_id": req.id,
+                    "resumed": bool(bundle)}
+        except MigrationError as e:
+            obs.counter_add(f"serve.migrate.rejected.{e.reason}")
+            raise
+
     # ---- recovery (restart after preemption / kill) ----
     def _recover(self):
         import json as _json
         reqs = [r for r in self.store.load_all()
-                if r.status in ("queued", "running", "preempted")]
+                if r.status in ("queued", "running", "preempted",
+                                "migrating")]
+        if not reqs:
+            return
+        live = []
+        for r in reqs:
+            if r.status == "migrating":
+                # the donor (us, last life) died mid-handoff with the
+                # commit outcome unknown — the peer's durable record
+                # is the truth. Present: the handoff DID land, settle
+                # migrated. Absent/unreachable: re-admit locally (the
+                # receiver's idempotent commit is the double-admission
+                # guard if the ack was merely late). Either way the
+                # restarted process re-books the offer so ITS ledger
+                # balances (the dead process's counters died with it).
+                obs.counter_add("serve.migrate.offered")
+                if resolve_interrupted_migration(r.peer, r.id):
+                    r.finished_unix = r.finished_unix or time.time()
+                    r.status = "migrated"
+                    self.store.save(r)
+                    with self._req_lock:
+                        self._requests[r.id] = r
+                    obs.counter_add("serve.migrate.handed_off")
+                    obs.event("serve.migrate",
+                              {"id": r.id, "peer": r.peer,
+                               "resolved": "interrupted handoff had "
+                                           "landed"})
+                    continue
+                reason = "interrupted"
+                obs.counter_add(f"serve.migrate.aborted.{reason}")
+                obs.event("serve.migrate_abort",
+                          {"id": r.id, "peer": r.peer,
+                           "reason": reason})
+                r.peer = None
+            if r.status in ("running", "preempted", "migrating"):
+                # poison-pill quarantine: a record that keeps getting
+                # re-admitted without ever finishing is taking the
+                # service down with it — settle it failed with the
+                # count instead of crash-looping forever
+                r.recoveries += 1
+                if r.recoveries > self.cfg.max_recoveries:
+                    obs.counter_add("serve.request.quarantined")
+                    obs.event("serve.quarantine",
+                              {"id": r.id, "recoveries": r.recoveries})
+                    global_toc(f"serve: quarantining {r.id} "
+                               f"(recovered {r.recoveries}x without "
+                               "finishing)")
+                    self._finish(
+                        r, "failed",
+                        error=f"quarantined: recovered {r.recoveries} "
+                              f"times without finishing (poison "
+                              f"pill? raise --max-recoveries to "
+                              f"retry)")
+                    with self._req_lock:
+                        self._requests[r.id] = r
+                    continue
+            live.append(r)
+        reqs = live
         if not reqs:
             return
         by_id = {r.id: r for r in reqs}
@@ -469,17 +776,8 @@ class ServeService:
                                            "bundle": r.resume_from})
                 continue
             r.group = None
-            if r.status in ("running", "preempted"):
-                bundle = latest_bundle(self._ckpt_ns(r.id))
-                if bundle is None and "chain" in r.payload:
-                    step = len(r.chain_results)
-                    for j in (step, step - 1):
-                        if j < 0:
-                            break
-                        bundle = latest_bundle(
-                            self._ckpt_ns(f"{r.id}-step{j}"))
-                        if bundle is not None:
-                            break
+            if r.status in ("running", "preempted", "migrating"):
+                bundle = self._resume_bundle_for(r)
                 if bundle is not None:
                     r.resume_from = bundle
                     r.resumed = True
@@ -551,6 +849,11 @@ class ServeService:
         self.store.save(req)
         if status == "done":
             obs.counter_add("serve.requests.completed")
+            if req.migrated_from:
+                # the receiver-side close of a handoff: the migrated-in
+                # request actually finished here — the gate's e2e
+                # signal (regression_gate migrate smoke)
+                obs.counter_add("serve.migrate.completed")
         elif status == "failed":
             obs.counter_add("serve.requests.failed")
         obs.event("serve.result", {"id": req.id, "status": status,
@@ -594,12 +897,25 @@ class ServeService:
     def _run_group(self, group):
         if self._preempting:
             # popped in the race window around the preemption notice:
-            # park instead of launching a wheel the shutdown would kill
+            # park (or hand off) instead of launching a wheel the
+            # shutdown would kill
             for r in group:
-                r.status = "preempted"
-                self.store.save(r)
-            obs.counter_add("serve.requests.preempted", len(group))
+                self._park_or_migrate(r)
             return
+        if self._draining:
+            # drain-for-deploy: queued work leaves BEFORE spending a
+            # wheel on it; whatever no peer takes runs here, solo
+            # no-batch — drain degrades to "finish local work", never
+            # to losing it
+            keep = []
+            for r in group:
+                if getattr(r, "_no_migrate", False) \
+                        or not self._migrate_out(r):
+                    r._no_migrate = True
+                    keep.append(r)
+            group = keep
+            if not group:
+                return
         bucket = group[0].bucket
         base = self._base_batch(bucket, group[0].payload)
         rec_ints = self._has_recourse_integers(base)
@@ -647,10 +963,13 @@ class ServeService:
                                 if (gid is None and rec_ints)
                                 else None)
         if wheel["preempted"]:
+            # the donor half of a live handoff: the hub's forced final
+            # bundle (handle_preemption) is exactly what the peer
+            # resumes from — solo wheels ship it, group members hand
+            # off record-only (the stacked bundle's fingerprint is
+            # stack-specific)
             for r in group:
-                r.status = "preempted"
-                self.store.save(r)
-            obs.counter_add("serve.requests.preempted", len(group))
+                self._park_or_migrate(r, gid=gid)
             return
         if wheel["deadline_missed"]:
             if gid is not None:
@@ -748,6 +1067,13 @@ class ServeService:
                 watchdog = WheelDeadline(hub, max(0.1, float(deadline)))
                 watchdog.start()
             obs.counter_add("serve.wheels")
+            if self._fault_injector is not None:
+                # chaos harness (testing/faults "serve" plan): kill /
+                # SIGTERM / wedge at the Nth wheel launch — the wedge
+                # sleeps here so the WheelDeadline watchdog (already
+                # armed above) fires exactly as it would for a hung
+                # iteration
+                self._fault_injector.on_wheel_start()
             resumed_iter = int(getattr(engine, "_iter", 0) or 0)
             hub.main()
             outer, inner = hub.hub_finalize()
@@ -832,9 +1158,7 @@ class ServeService:
                                           "request": req.id})
         for j in range(start, len(steps)):
             if self._stop or self._preempting:
-                req.status = "preempted"
-                self.store.save(req)
-                obs.counter_add("serve.requests.preempted")
+                self._park_or_migrate(req)
                 return
             ns = f"{req.id}-step{j}"
             resume_from = req.resume_from if j == start else None
@@ -851,9 +1175,7 @@ class ServeService:
                 solo_incumbent=dive_incumbent_result
                 if self._has_recourse_integers(base) else None)
             if wheel["preempted"]:
-                req.status = "preempted"
-                self.store.save(req)
-                obs.counter_add("serve.requests.preempted")
+                self._park_or_migrate(req)
                 return
             if wheel["deadline_missed"]:
                 obs.counter_add("serve.requests.deadline_missed")
@@ -877,11 +1199,40 @@ class ServeService:
 
 def _write_endpoint_file(state_dir, port):
     """``<state_dir>/serve.json``: where clients (and the tier-1 test)
-    find an ephemeral-port service. Atomic like every serve artifact."""
+    find an ephemeral-port service. Atomic like every serve artifact.
+    ``pid`` + ``started_at`` make staleness decidable: clients
+    (serve/migrate.read_endpoint) and a restarting service check the
+    recorded pid before trusting the port — a file left by a killed
+    process must read as "no service", not as an endpoint."""
     path = os.path.join(state_dir, "serve.json")
+    now = time.time()
     atomic_write_json(path, {"port": port, "pid": os.getpid(),
-                             "started_unix": time.time()})
+                             "started_unix": now,
+                             "started_at": time.strftime(
+                                 "%Y-%m-%dT%H:%M:%S%z",
+                                 time.localtime(now))})
     return path
+
+
+def _check_endpoint_file(state_dir) -> bool:
+    """Startup guard for ``serve.json``: a recorded LIVE foreign pid
+    means another service already owns this state dir (two writers
+    would corrupt the request store) — refuse. A dead pid is just a
+    stale file from a killed process: overwrite and carry on."""
+    info, stale = read_endpoint(state_dir)
+    if info is None or info.get("pid") in (None, os.getpid()):
+        return True
+    if not stale:
+        global_toc(f"serve: {state_dir}/serve.json records a live "
+                   f"service (pid {info['pid']}, port "
+                   f"{info.get('port')}) — refusing a second writer "
+                   "on this state dir")
+        return False
+    obs.event("serve.endpoint_stale", {"pid": info.get("pid"),
+                                       "port": info.get("port")})
+    global_toc(f"serve: overwriting stale serve.json "
+               f"(dead pid {info.get('pid')})")
+    return True
 
 
 def make_serve_parser():
@@ -926,6 +1277,24 @@ def make_serve_parser():
                    help="sweep terminal request records (and their "
                         "ckpt namespaces) older than this many "
                         "seconds at startup (default 7 days)")
+    p.add_argument("--peers", type=str, default="",
+                   help="comma-separated peer base URLs "
+                        "(host:port or http://host:port) this host "
+                        "may hand live wheels to; empty = solo host "
+                        "(SIGTERM stays bundle-and-exit)")
+    p.add_argument("--migrate-deadline", type=float, default=60.0,
+                   help="per-transfer wall-clock budget (seconds) for "
+                        "one live handoff; on expiry the donor aborts "
+                        "and finishes the wheel itself")
+    p.add_argument("--migrate-retries", type=int, default=3,
+                   help="retry attempts per migration HTTP call "
+                        "(jittered exponential backoff under the "
+                        "transfer deadline)")
+    p.add_argument("--max-recoveries", type=int, default=3,
+                   help="poison-pill bound: a request re-admitted by "
+                        "startup recovery more than this many times "
+                        "settles failed (quarantined) instead of "
+                        "crash-looping the service")
     p.add_argument("--telemetry-dir", type=str, default=None,
                    help="unified telemetry for the service process "
                         "(doc/observability.md); also enables the "
@@ -955,15 +1324,30 @@ def serve_main(argv=None) -> int:
         checkpoint_interval=args.checkpoint_interval,
         default_deadline=args.default_deadline,
         request_retention=args.request_retention,
-        telemetry_dir=args.telemetry_dir).validate()
+        telemetry_dir=args.telemetry_dir,
+        peers=tuple(p.strip() for p in args.peers.split(",")
+                    if p.strip()),
+        migrate_deadline=args.migrate_deadline,
+        migrate_retries=args.migrate_retries,
+        max_recoveries=args.max_recoveries).validate()
     setup_jax_runtime(args.f32)
     if cfg.telemetry_dir:
         obs.configure(out_dir=cfg.telemetry_dir, role="serve",
                       config={"serve": cfg.to_dict()})
     else:
         obs.maybe_configure_from_env(role="serve")
+    if not _check_endpoint_file(cfg.state_dir):
+        return 2
 
-    service = ServeService(cfg).start()
+    service = ServeService(cfg)
+    if os.environ.get("MPISPPY_TPU_FAULT_PLAN"):
+        # lint: ok[PURE001] env-gated: MPISPPY_TPU_FAULT_PLAN only — the clean path never imports testing (chaos runs opt in)
+        from ..testing.faults import ServeFaultInjector
+        inj = ServeFaultInjector.from_env()
+        if inj is not None:
+            service._fault_injector = inj
+            inj.start_timers()
+    service.start()
     done = threading.Event()
 
     def _drain():

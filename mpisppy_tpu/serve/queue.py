@@ -5,6 +5,7 @@ as ``<state_dir>/requests/<id>.json`` (atomic tmp+``os.replace``, the
 ckpt/live.json contract), updated on every transition —
 
     queued -> running -> done | failed | preempted
+                      -> migrating -> migrated   (live handoff)
 
 so results outlive the connection (``GET /result/<id>`` replays the
 file), and a killed service re-admits everything that was queued or
@@ -35,9 +36,15 @@ from ..ckpt.bundle import atomic_write_json
 REQUEST_SCHEMA = 1
 
 # terminal states never re-admit; the rest re-enter the queue on a
-# service restart (serve/manager.recover_requests)
+# service restart (serve/manager.recover_requests). "migrating" is the
+# two-phase-commit limbo of a live handoff (serve/migrate): recovery
+# resolves it by probing the peer. "migrated" is this host's FINAL
+# state for a handed-off request — not in TERMINAL (the result lives
+# on the peer, clients follow the recorded peer hint) but never
+# re-admitted and swept with the terminals.
 TERMINAL = ("done", "failed")
-STATES = ("queued", "running", "done", "failed", "preempted")
+STATES = ("queued", "running", "done", "failed", "preempted",
+          "migrating", "migrated")
 
 
 class QueueFull(RuntimeError):
@@ -68,6 +75,13 @@ class Request:
         self.resumed = False
         self.no_batch = False             # set after a failed group run
         self.chain_results = []           # completed rolling-horizon steps
+        # fleet fields (serve/migrate): how many times startup recovery
+        # has re-admitted this record (poison-pill quarantine trips at
+        # --max-recoveries), the peer base URL a handoff targeted, and
+        # — on the RECEIVER — the donor this request migrated in from
+        self.recoveries = 0
+        self.peer = None
+        self.migrated_from = None
 
     def deadline_remaining(self, now=None) -> float | None:
         if self.deadline_unix is None:
@@ -85,7 +99,9 @@ class Request:
                 "deadline_unix": self.deadline_unix,
                 "group": self.group, "result": self.result,
                 "error": self.error, "resumed": self.resumed,
-                "chain_results": self.chain_results}
+                "chain_results": self.chain_results,
+                "recoveries": self.recoveries, "peer": self.peer,
+                "migrated_from": self.migrated_from}
 
     @classmethod
     def from_json(cls, d: dict) -> "Request":
@@ -103,6 +119,9 @@ class Request:
         req.resumed = bool(d.get("resumed", False))
         req.no_batch = bool(d.get("no_batch", False))
         req.chain_results = list(d.get("chain_results") or [])
+        req.recoveries = int(d.get("recoveries") or 0)
+        req.peer = d.get("peer")
+        req.migrated_from = d.get("migrated_from")
         return req
 
     def summary(self) -> dict:
@@ -111,7 +130,7 @@ class Request:
                 "bucket": self.bucket, "group": self.group,
                 "submitted_unix": self.submitted_unix,
                 "deadline_unix": self.deadline_unix,
-                "resumed": self.resumed}
+                "resumed": self.resumed, "peer": self.peer}
 
 
 class RequestStore:

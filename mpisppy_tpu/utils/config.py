@@ -466,6 +466,16 @@ class ServeConfig:
     # accrete one json per request forever.
     request_retention: float = 7 * 24 * 3600.0
     telemetry_dir: str | None = None
+    # fleet (serve/migrate): peer base URLs this host may hand live
+    # wheels to (empty = solo host, SIGTERM stays bundle-and-exit);
+    # per-transfer wall-clock budget + per-call retry attempts for one
+    # handoff; and the poison-pill bound — a request re-admitted by
+    # startup recovery more than max_recoveries times quarantines
+    # (settles failed) instead of crash-looping the service forever.
+    peers: tuple = ()
+    migrate_deadline: float = 60.0
+    migrate_retries: int = 3
+    max_recoveries: int = 3
 
     def validate(self):
         if not self.state_dir:
@@ -489,6 +499,16 @@ class ServeConfig:
             raise ValueError("default_deadline must be positive seconds")
         if self.request_retention <= 0:
             raise ValueError("request_retention must be positive seconds")
+        for p in self.peers:
+            if not str(p).strip():
+                raise ValueError("peers must be non-empty host[:port] "
+                                 "or http:// base URLs")
+        if self.migrate_deadline <= 0:
+            raise ValueError("migrate_deadline must be positive seconds")
+        if self.migrate_retries < 1:
+            raise ValueError("migrate_retries must be >= 1")
+        if self.max_recoveries < 1:
+            raise ValueError("max_recoveries must be >= 1")
         return self
 
     def to_dict(self) -> dict:

@@ -244,7 +244,12 @@ def test_disabled_mode_allocates_nothing():
     test; tracemalloc sees no allocations attributed to the diagnose
     module. (Attribution is scoped to diagnose.py, not the whole obs
     package — in full-suite runs, background threads left by earlier
-    tests can allocate elsewhere in obs during the window.)"""
+    tests can allocate elsewhere in obs during the window. Even so,
+    a frame passing through diagnose can be charged noise from GC
+    timing, so the probe takes up to three measurement windows and a
+    real leak — which would recur every window — must show in ALL of
+    them to fail.)"""
+    import gc
     import tracemalloc
 
     assert not obs.enabled()
@@ -253,21 +258,27 @@ def test_disabled_mode_allocates_nothing():
     assert diagnose.note_bound_check(1, -1.0, 0.0, 0.5) is None
     assert diagnose.snapshot() is None
     mod = diagnose.__file__
-    tracemalloc.start()
-    before = tracemalloc.take_snapshot()
-    for _ in range(500):
-        diagnose.note_sample(fx)
-        diagnose.note_bound_check(1, -1.0, 0.0, 0.5)
-        diagnose.snapshot()
-    after = tracemalloc.take_snapshot()
-    tracemalloc.stop()
-    leaked = sum(s.size_diff
-                 for s in after.compare_to(before, "lineno")
-                 if s.size_diff > 0
-                 and any(str(fr.filename) == mod
-                         for fr in s.traceback))
+    leaked = None
+    for _window in range(3):
+        gc.collect()
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for _ in range(500):
+            diagnose.note_sample(fx)
+            diagnose.note_bound_check(1, -1.0, 0.0, 0.5)
+            diagnose.snapshot()
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        leaked = sum(s.size_diff
+                     for s in after.compare_to(before, "lineno")
+                     if s.size_diff > 0
+                     and any(str(fr.filename) == mod
+                             for fr in s.traceback))
+        if leaked < 500:
+            return
     assert leaked < 500, \
-        f"disabled-mode diagnose calls allocated {leaked} B"
+        f"disabled-mode diagnose calls allocated {leaked} B in every " \
+        f"measurement window"
 
 
 # ---------------- the verdict rules ----------------

@@ -402,11 +402,13 @@ def test_pure001_static_over_real_tree():
     rep = lint_paths(["mpisppy_tpu", "tools"], LintConfig(),
                      rules=["PURE001"])
     assert rep["findings"] == [], rep["findings"]
-    # the two env-gated fault-injector sites are the only sanctioned
-    # suppressions of this contract
-    assert len(rep["suppressed"]) == 2
-    assert all(f["path"] == "mpisppy_tpu/utils/multiproc.py"
-               for f in rep["suppressed"])
+    # the env-gated fault-injector sites (worker side in multiproc,
+    # serve side in the manager) are the only sanctioned suppressions
+    # of this contract
+    assert len(rep["suppressed"]) == 3
+    assert sorted({f["path"] for f in rep["suppressed"]}) == [
+        "mpisppy_tpu/serve/manager.py",
+        "mpisppy_tpu/utils/multiproc.py"]
 
 
 def test_jax_free_modules_import_without_jax():

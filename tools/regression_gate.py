@@ -51,6 +51,14 @@ outer bound freezes over a real gap) must judge non-HEALTHY with an
 evidence-carrying verdict (doc/forensics.md) — the diagnosis engine
 is gated from both the false-positive and the false-negative side.
 
+Since ISSUE 20 a migration smoke rides last (``--skip-migrate-smoke``
+opts out): two serve processes peered at each other, one in-flight
+farmer request, SIGTERM on the donor mid-wheel — the request must
+complete on the RECEIVER with ``resumed_from_iter > 0`` and
+``serve.migrate.completed == 1`` on its /metrics (the live-handoff
+contract, doc/serving.md), so a protocol or bundle-transfer regression
+fails in CI instead of during a real eviction.
+
 Exit codes (analyze's own): 0 PASS, 2 usage / schema refusal,
 3 REGRESSION.
 
@@ -243,6 +251,141 @@ def run_serve_smoke(work_dir: str) -> int:
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+def run_migrate_smoke(work_dir: str) -> int:
+    """The ISSUE 20 CI rider: the live-migration handoff contract,
+    gated end to end. Two serve processes on ephemeral pre-picked
+    ports, ``--peers`` pointed at each other; one slow farmer request
+    lands on the donor, and once its wheel has checkpointed, the donor
+    gets SIGTERM — with a live peer that escalates from bundle-and-
+    exit to migrate-then-exit (doc/serving.md). The request must
+    complete ON THE RECEIVER with ``resumed_from_iter > 0`` (the
+    bundle actually resumed, not a cold re-run) and
+    ``serve.migrate.completed == 1`` on the receiver's /metrics."""
+    import json
+    import signal
+    import socket
+    import time
+    import urllib.request
+
+    def _free_port():
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def _get(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+
+    def _post(url, obj):
+        req = urllib.request.Request(
+            url, data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)
+    ports = (_free_port(), _free_port())
+    states = [os.path.join(work_dir, f"migrate_{n}")
+              for n in ("donor", "receiver")]
+    procs = []
+    try:
+        for i, (port, state) in enumerate(zip(ports, states)):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "mpisppy_tpu", "serve",
+                 "--port", str(port), "--state-dir", state,
+                 "--peers", f"127.0.0.1:{ports[1 - i]}",
+                 "--batch-window", "0.05",
+                 "--checkpoint-interval", "0.2",
+                 "--migrate-deadline", "30",
+                 "--telemetry-dir",
+                 os.path.join(state, "telemetry")],
+                cwd=REPO, env=env))
+        bases = [f"http://127.0.0.1:{p}" for p in ports]
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                print("regression_gate: a migrate-smoke serve process "
+                      "died at startup")
+                return 1
+            try:
+                if all(json.loads(_get(f"{b}/healthz")).get("ok")
+                       for b in bases):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        else:
+            print("regression_gate: migrate-smoke fleet never became "
+                  "healthy")
+            return 1
+        # a deliberately long wheel: enough iterations that the donor
+        # is still mid-flight when the SIGTERM lands
+        rid = _post(f"{bases[0]}/solve",
+                    {"model": "farmer", "num_scens": 3,
+                     "algo": {"max_iterations": 120,
+                              "convthresh": -1.0}})["request_id"]
+        # wait for the donor's wheel to have a bundle to hand off —
+        # the LATEST pointer under the request's ckpt namespace is the
+        # deterministic signal
+        latest = os.path.join(states[0], "ckpt", rid, "LATEST")
+        bundle_end = time.time() + 120
+        while time.time() < bundle_end and not os.path.exists(latest):
+            time.sleep(0.1)
+        if not os.path.exists(latest):
+            print("regression_gate: donor wheel never checkpointed")
+            return 3
+        procs[0].send_signal(signal.SIGTERM)
+        rec = None
+        poll_end = time.time() + 300
+        while time.time() < poll_end:
+            try:
+                rec = json.loads(_get(f"{bases[1]}/result/{rid}"))
+                if rec.get("status") in ("done", "failed"):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.3)
+        if rec is None or rec.get("status") != "done":
+            print("regression_gate: MIGRATION SMOKE FAILURE — the "
+                  "SIGTERM'd donor's request never completed on the "
+                  f"receiver (last record: {rec})")
+            return 3
+        resumed = (rec["result"].get("wheel") or {}).get(
+            "resumed_from_iter")
+        if not resumed or resumed <= 0:
+            print("regression_gate: MIGRATION SMOKE REGRESSION — the "
+                  "receiver re-ran the request cold "
+                  f"(resumed_from_iter={resumed!r}); the handed-off "
+                  "bundle must resume through load_bundle")
+            return 3
+        metrics = _get(f"{bases[1]}/metrics")
+        line = next((ln for ln in metrics.splitlines() if ln.startswith(
+            "mpisppy_tpu_serve_migrate_completed ")), None)
+        if line is None or float(line.split()[1]) != 1:
+            print("regression_gate: MIGRATION SMOKE REGRESSION — "
+                  "receiver /metrics shows serve.migrate.completed "
+                  f"{line!r}, expected exactly 1")
+            return 3
+        print(f"regression_gate: migrate smoke ok (request completed "
+              f"on the receiver, resumed from iteration {resumed})")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
 
 def run_stream_smoke(work_dir: str) -> int:
@@ -480,6 +623,10 @@ def main(argv=None) -> int:
                    help="skip the serving-layer compile-once smoke "
                         "stage (doc/serving.md); the bench + compare "
                         "gate still runs")
+    p.add_argument("--skip-migrate-smoke", action="store_true",
+                   help="skip the live-migration handoff smoke stage "
+                        "(doc/serving.md); the bench + compare gate "
+                        "still runs")
     p.add_argument("--skip-stream-smoke", action="store_true",
                    help="skip the streamed-farmer flat-transfer smoke "
                         "stage (doc/streaming.md); the bench + compare "
@@ -581,12 +728,19 @@ def main(argv=None) -> int:
             rc = run_stream_smoke(fresh)
             if rc != 0:
                 return rc
-        if args.skip_serve_smoke:
+        if not args.skip_serve_smoke:
+            # serve smoke (ISSUE 13): the compile-once contract on
+            # the serving layer — same lint-first -> bench -> compare
+            # pipeline, one more stage
+            rc = run_serve_smoke(fresh)
+            if rc != 0:
+                return rc
+        if args.skip_migrate_smoke:
             return rc
-        # serve smoke last (ISSUE 13): the compile-once contract on
-        # the serving layer — same lint-first -> bench -> compare
-        # pipeline, one more stage
-        return run_serve_smoke(fresh)
+        # migration smoke last (ISSUE 20): SIGTERM the donor of a
+        # 2-process fleet mid-wheel; the receiver must finish the
+        # request from the handed-off bundle
+        return run_migrate_smoke(fresh)
     finally:
         if args.keep is None:
             shutil.rmtree(fresh, ignore_errors=True)

@@ -28,15 +28,27 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCHEMA_VERSION = 1
+
+
+def _pid_alive(pid) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (ProcessLookupError, ValueError, TypeError):
+        return False
+    except OSError:
+        return True
+    return True
 
 
 def _get(url, timeout=10):
@@ -97,8 +109,14 @@ def measure_point(max_wheels, batch_max, requests, num_scens,
             if proc.poll() is not None:
                 raise RuntimeError("serve process died at startup")
             if os.path.isfile(ep):
-                port = json.load(open(ep, encoding="utf-8"))["port"]
-                break
+                d = json.load(open(ep, encoding="utf-8"))
+                # staleness gate: a serve.json whose recorded pid is
+                # dead is a leftover from a killed process — keep
+                # waiting for OUR service to write, never connect to
+                # nothing
+                if _pid_alive(d.get("pid")):
+                    port = d["port"]
+                    break
             time.sleep(0.2)
         if port is None:
             raise RuntimeError("serve endpoint file never appeared")
@@ -113,29 +131,49 @@ def measure_point(max_wheels, batch_max, requests, num_scens,
                                f"{(rec or {}).get('status', 'timeout')}")
         t0 = time.time()
         # the burst deliberately outruns admission at aggressive grid
-        # points — a 429/503 rejection is a MEASUREMENT (the point
-        # dropped requests), not a sweep-killing exception
-        import urllib.error
-        rids, failed = [], 0
+        # points. A 429/503 carries Retry-After (doc/serving.md) — the
+        # client backs off with jitter and retries instead of
+        # hammering; a point that only completed via backoff is
+        # reported separately (retried_ok) from first-try admissions.
+        rng = random.Random(0)
+        rids, retried, failed = [], set(), 0
         for i in range(requests):
-            try:
-                rids.append(_post(
-                    f"{base}/solve",
-                    _payload(num_scens, max_iterations, i))
-                    ["request_id"])
-            except (urllib.error.HTTPError, urllib.error.URLError):
+            rid, was_retried = None, False
+            for _attempt in range(4):
+                try:
+                    rid = _post(
+                        f"{base}/solve",
+                        _payload(num_scens, max_iterations, i))[
+                        "request_id"]
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code not in (429, 503):
+                        break
+                    was_retried = True
+                    retry = float(e.headers.get("Retry-After") or 1.0)
+                    time.sleep(retry * (0.5 + rng.random()))
+                except urllib.error.URLError:
+                    break
+            if rid is None:
                 failed += 1
-        done = 0
+            else:
+                rids.append(rid)
+                if was_retried:
+                    retried.add(rid)
+        done = retried_ok = 0
         for r in rids:
             rec = _wait_done(base, r, budget)
             if rec is not None and rec["status"] == "done":
                 done += 1
+                if r in retried:
+                    retried_ok += 1
             else:
                 failed += 1
         elapsed = time.time() - t0
         return {"metric": "serve_load", "schema_version": SCHEMA_VERSION,
                 "max_wheels": max_wheels, "batch_max": batch_max,
                 "requests": requests, "done": done, "failed": failed,
+                "retried_ok": retried_ok,
                 "num_scens": num_scens,
                 "max_iterations": max_iterations,
                 "elapsed_s": elapsed,
