@@ -32,7 +32,10 @@ Endpoints:
   members with sha256 verification, commit gates the bundle through
   ``load_bundle`` and admits the request via force-push recovery.
   Refusals are reasoned 4xx bodies the donor books as
-  ``serve.migrate.aborted.<reason>``.
+  ``serve.migrate.aborted.<reason>``. ``POST /migrate/abort`` releases
+  a staged offer when the donor gives up mid-protocol (best-effort;
+  the receiver's TTL sweep is the backstop for donors that die
+  without saying so).
 
 ``429`` and ``503`` responses carry ``Retry-After`` so clients back
 off instead of hammering; a draining 503 adds ``"peer"`` — the live
@@ -208,8 +211,15 @@ class _ServeHTTPServer(ThreadingHTTPServer):
             except MigrationError as e:
                 return _json_body(409 if e.reason != "refused" else 400,
                                   {"error": str(e), "reason": e.reason})
+        if path == "/migrate/abort":
+            try:
+                return _json_body(200, svc.migrate_abort(_parse()))
+            except MigrationError as e:
+                return _json_body(400, {"error": str(e),
+                                        "reason": e.reason})
         return (404, _TEXT, b"unknown POST path; try /solve /shutdown "
-                            b"/drain /migrate/offer /migrate/commit\n")
+                            b"/drain /migrate/offer /migrate/commit "
+                            b"/migrate/abort\n")
 
     def _put(self, path_q, stream, length):
         """``PUT /migrate/bundle/<id>?file=<name>`` — one streamed

@@ -288,7 +288,12 @@ class ServeService:
         # SIGTERM escalation latch (bundle-and-exit becomes
         # migrate-then-exit when a live peer exists)
         self.peers = PeerRegistry(cfg.peers) if cfg.peers else None
-        self.receiver = MigrationReceiver(cfg.state_dir)
+        # a legit open offer lives at most one donor transfer deadline;
+        # 4x is the generous bound past which the donor is presumed
+        # dead and the staged offer reclaimed (sweep)
+        self.receiver = MigrationReceiver(
+            cfg.state_dir,
+            offer_ttl=max(120.0, 4.0 * cfg.migrate_deadline))
         self._draining = False
         self._migrate_exit = False
         self._fault_injector = None   # testing/faults.ServeFaultInjector
@@ -602,7 +607,7 @@ class ServeService:
     def migrate_offer(self, payload: dict) -> dict:
         try:
             if self._preempting or self._stop or self._draining:
-                raise MigrationError("refused",
+                raise MigrationError("draining",
                                      "receiver is draining/stopping")
             inj = self._fault_injector
             if inj is not None:
@@ -612,10 +617,19 @@ class ServeService:
                 if verdict == "refuse":
                     raise MigrationError("refused",
                                          "fault plan: refuse_peer")
+            self.receiver.sweep()
             rid = ((payload or {}).get("request") or {}).get("id")
-            if rid and self.store.load(rid) is not None:
+            prior = self.store.load(rid) if rid else None
+            if prior is not None and prior.status != "migrated":
                 # idempotent by request id: an earlier handoff of this
-                # request already landed — ack without re-staging
+                # request already landed (or it ran here) — ack
+                # without re-staging. A local record in the
+                # ``migrated`` state is the ONE exception: that is
+                # this host's hand-AWAY marker, not ownership — a
+                # round-trip offer (we migrated it out, the peer now
+                # drains it back) must re-admit and supersede the
+                # stale record, because acking 'already' would leave
+                # BOTH hosts settled 'migrated' and lose the request.
                 return {"ok": True, "already": True, "request_id": rid}
             out = self.receiver.offer(payload)
             obs.counter_add("serve.migrate.accepted")
@@ -634,16 +648,29 @@ class ServeService:
 
     def migrate_commit(self, payload: dict) -> dict:
         try:
+            mid = (payload or {}).get("migration_id")
+            if self._preempting or self._stop or self._draining:
+                # mirror the offer guard: an offer staged just before
+                # the drain began must not commit onto an evacuating
+                # host (it would be admitted only to migrate straight
+                # back out) — drop the staging and send the donor a
+                # reasoned refusal so it finishes the wheel locally
+                if mid:
+                    self.receiver.abort(mid)
+                raise MigrationError("draining",
+                                     "receiver is draining/stopping")
             rid = (payload or {}).get("request_id")
-            if rid and self.store.load(rid) is not None:
+            prior = self.store.load(rid) if rid else None
+            if prior is not None and prior.status != "migrated":
                 # the donor's ack got lost and it re-committed (or
                 # re-offered): the request is already durable here —
-                # ack idempotently, never admit twice
-                mid0 = (payload or {}).get("migration_id")
-                if mid0:
-                    self.receiver.abort(mid0)
+                # ack idempotently, never admit twice. A stale
+                # ``migrated`` record (this host handed the request
+                # away earlier; it is round-tripping home) does NOT
+                # short-circuit — the admission below supersedes it.
+                if mid:
+                    self.receiver.abort(mid)
                 return {"ok": True, "already": True, "request_id": rid}
-            mid = (payload or {}).get("migration_id")
             if not mid:
                 raise MigrationError("refused",
                                      "commit needs migration_id")
@@ -679,6 +706,18 @@ class ServeService:
         except MigrationError as e:
             obs.counter_add(f"serve.migrate.rejected.{e.reason}")
             raise
+
+    def migrate_abort(self, payload: dict) -> dict:
+        """The donor gave up after a successful offer (transfer
+        failed, deadline hit, commit refused): drop the staged offer
+        now instead of leaking it until the TTL sweep. Idempotent —
+        an unknown or already-consumed id is a no-op."""
+        mid = (payload or {}).get("migration_id")
+        if not mid:
+            raise MigrationError("refused", "abort needs migration_id")
+        self.receiver.abort(str(mid))
+        obs.counter_add("serve.migrate.offer_aborted")
+        return {"ok": True, "migration_id": mid}
 
     # ---- recovery (restart after preemption / kill) ----
     def _recover(self):
@@ -799,6 +838,7 @@ class ServeService:
     # ---- the wheel workers ----
     def _worker_loop(self):
         while not self._stop:
+            self.receiver.sweep()   # reclaim offers from dead donors
             group = None
             if self._recovered_groups:
                 try:

@@ -13,6 +13,7 @@ The wire protocol (three endpoints on the receiving service plane):
     POST /migrate/offer          {migration_id, request, bundle?}
     PUT  /migrate/bundle/<id>?file=<name>     (raw member bytes)
     POST /migrate/commit         {migration_id}
+    POST /migrate/abort          {migration_id}   (donor gave up)
 
 Two-phase commit: the donor flips the durable request record to the
 ``migrating`` state BEFORE the first wire byte and settles it to
@@ -252,9 +253,14 @@ class MigrationClient:
             if 200 <= status < 300:
                 return body
             if 400 <= status < 500:
+                # a reasoned refusal body (http.py sends the receiver's
+                # MigrationError reason) survives the wire so the donor
+                # books the REAL abort cause (draining, transfer,
+                # bundle_rejected) instead of a generic "refused"
+                detail = body if isinstance(body, dict) else {}
                 raise MigrationError(
-                    "refused", f"{what} -> {status} "
-                               f"{(body or {}).get('error', '')}")
+                    str(detail.get("reason") or "refused"),
+                    f"{what} -> {status} {detail.get('error', '')}")
             last = f"{what} -> {status}"
             self._sleep(attempt)
         raise MigrationError("unreachable", last or what)
@@ -282,28 +288,39 @@ class MigrationClient:
             # idempotency fast path: the receiver has this request id
             # from an earlier (interrupted) handoff — nothing to send
             return ack
-        for fn in sorted(files):
-            self._send_member(mid, bundle_dir, fn, files[fn])
-        commit = {"schema": MIGRATE_SCHEMA, "migration_id": mid,
-                  "request_id": record.get("id")}
         try:
-            out = self._call("commit", lambda: http_json(
-                "POST", self.peer, "/migrate/commit", commit,
-                timeout=self.call_timeout)) or {}
-        except MigrationError as e:
-            if e.reason == "refused":
-                # the receiver examined the staged bundle and said no
-                # (load_bundle gate) — a reasoned semantic refusal,
-                # not a transport failure
-                raise MigrationError("bundle_rejected", str(e)) from e
-            # the commit outcome is AMBIGUOUS (ack may have been lost
-            # after the receiver admitted) — probe the durable record
-            # before declaring the handoff dead, else both hosts could
-            # run the request
-            if self.probe_committed(record.get("id")):
-                return {"ok": True, "already": True}
+            for fn in sorted(files):
+                self._send_member(mid, bundle_dir, fn, files[fn])
+            commit = {"schema": MIGRATE_SCHEMA, "migration_id": mid,
+                      "request_id": record.get("id")}
+            try:
+                out = self._call("commit", lambda: http_json(
+                    "POST", self.peer, "/migrate/commit", commit,
+                    timeout=self.call_timeout)) or {}
+            except MigrationError as e:
+                if e.reason in ("unreachable", "timeout"):
+                    # the commit outcome is AMBIGUOUS (ack may have
+                    # been lost after the receiver admitted) — probe
+                    # the durable record before declaring the handoff
+                    # dead, else both hosts could run the request
+                    if self.probe_committed(record.get("id")):
+                        return {"ok": True, "already": True}
+                    raise
+                if e.reason == "refused":
+                    # a bare commit refusal means the receiver
+                    # examined the staged bundle and said no
+                    # (load_bundle gate) — a semantic refusal, not a
+                    # transport failure
+                    raise MigrationError("bundle_rejected",
+                                         str(e)) from e
+                raise   # reasoned refusal (bundle_rejected, draining)
+            return out
+        except MigrationError:
+            # the receiver may still hold the staged offer — tell it
+            # to drop the staging now instead of leaking it until its
+            # TTL sweep (best-effort; the sweep is the backstop)
+            self._abort_offer(mid)
             raise
-        return out
 
     def _send_member(self, mid: str, bundle_dir: str, name: str,
                      meta: dict):
@@ -324,7 +341,7 @@ class MigrationClient:
         try:
             self._call(f"bundle member {name}", _once)
         except MigrationError as e:
-            if e.reason == "refused":
+            if e.reason in ("refused", "transfer"):
                 # hash/size mismatch is a transfer integrity failure
                 # (retried inside _call only for transport errors) —
                 # re-stream the member once more before giving up
@@ -335,19 +352,39 @@ class MigrationClient:
                     raise MigrationError("transfer", str(e)) from e
             raise
 
+    def _abort_offer(self, mid: str):
+        """Best-effort: release the receiver's staged offer after the
+        donor gives up, so the migrate_in dir does not linger on the
+        peer until its TTL sweep. Idempotent and allowed to fail — an
+        already-consumed or unknown id is a no-op over there."""
+        try:
+            http_json("POST", self.peer, "/migrate/abort",
+                      {"schema": MIGRATE_SCHEMA, "migration_id": mid},
+                      timeout=self.call_timeout)
+        except OSError:
+            pass
+
     def probe_committed(self, req_id: str | None) -> bool:
-        """Does the peer durably know this request? Used to resolve an
+        """Does the peer durably OWN this request? Used to resolve an
         ambiguous commit and by startup recovery to settle a request
-        found mid-``migrating`` (donor died before the ack landed)."""
+        found mid-``migrating`` (donor died before the ack landed).
+        A peer record in the ``migrated`` state does not count: that
+        is the peer's own hand-AWAY marker (it gave the request to
+        someone — possibly us), and settling our copy against it
+        would lose a round-tripped request."""
         if not req_id:
             return False
         try:
-            status, _ = http_json("GET", self.peer,
-                                  f"/result/{urllib.parse.quote(req_id)}",
-                                  timeout=self.call_timeout)
+            status, body = http_json(
+                "GET", self.peer,
+                f"/result/{urllib.parse.quote(req_id)}",
+                timeout=self.call_timeout)
         except OSError:
             return False
-        return status == 200
+        if status != 200:
+            return False
+        return not (isinstance(body, dict)
+                    and body.get("status") == "migrated")
 
 
 def resolve_interrupted_migration(peer: str | None, req_id: str,
@@ -381,8 +418,9 @@ class MigrationReceiver:
     raises ``MigrationError`` so the HTTP plane can answer with a
     reasoned 4xx."""
 
-    def __init__(self, state_dir: str):
+    def __init__(self, state_dir: str, offer_ttl: float = 900.0):
         self.dir = os.path.join(str(state_dir), "migrate_in")
+        self.offer_ttl = float(offer_ttl)
         self._offers: dict[str, dict] = {}
         self._lock = threading.Lock()
         # stale staging from a killed receiver is dead weight — a new
@@ -524,6 +562,24 @@ class MigrationReceiver:
         if off is not None:
             shutil.rmtree(off["staging"], ignore_errors=True)
 
+    def sweep(self, now: float | None = None) -> int:
+        """Reclaim offers whose donor went silent — a successful offer
+        whose commit (or abort) never arrived because the donor died,
+        timed out, or lost connectivity. Anything older than
+        ``offer_ttl`` drops with its staging dir, so a long-lived
+        receiver under flaky donors cannot accumulate unbounded
+        migrate_in disk or ``_offers`` memory. Returns the count
+        swept; cheap enough for a worker loop to call every tick."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            expired = [mid for mid, off in self._offers.items()
+                       if now - off["opened_unix"] > self.offer_ttl]
+        for mid in expired:
+            self.abort(mid)
+            obs.counter_add("serve.migrate.rejected.offer_expired")
+            obs.event("serve.migrate_expire", {"migration_id": mid})
+        return len(expired)
+
     def open_offers(self) -> int:
         with self._lock:
             return len(self._offers)
@@ -546,12 +602,38 @@ def pid_alive(pid) -> bool:
     return True
 
 
+def pid_start_time(pid) -> float | None:
+    """Unix start time of a live pid via ``/proc`` (Linux); None when
+    indeterminate (no /proc, pid gone, unparsable). The pid-reuse
+    disambiguator for endpoint files: a recycled pid belongs to a
+    process born AFTER the dead service wrote its record."""
+    try:
+        with open(f"/proc/{int(pid)}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # the parenthesized comm may itself contain spaces/parens —
+        # split only what follows the LAST ')'; starttime is stat
+        # field 22 (clock ticks since boot), index 19 after field 3
+        ticks = int(stat.rsplit(")", 1)[1].split()[19])
+        with open("/proc/stat", "rb") as f:
+            for line in f:
+                if line.startswith(b"btime"):
+                    return (int(line.split()[1])
+                            + ticks / os.sysconf("SC_CLK_TCK"))
+        return None
+    except (OSError, ValueError, IndexError, TypeError):
+        return None
+
+
 def read_endpoint(state_dir: str) -> tuple:
     """``(info, stale)`` for ``<state_dir>/serve.json``. ``info`` is
     the parsed endpoint record or None; ``stale`` is True when the
-    file exists but its recorded pid is dead — clients (loadbench,
-    the chaos driver, tests) must treat a stale file as "no service"
-    instead of connecting to nothing."""
+    file exists but its recorded pid is dead — OR alive yet provably
+    not the writer: after a reboot or long downtime the pid can be
+    recycled by an unrelated process, and the writer necessarily
+    predates its own serve.json, so a pid holder born after the
+    file's ``started_unix`` is a recycled pid, not the service.
+    Clients (loadbench, the chaos driver, tests) must treat a stale
+    file as "no service" instead of connecting to nothing."""
     path = os.path.join(str(state_dir), "serve.json")
     try:
         with open(path, encoding="utf-8") as f:
@@ -560,4 +642,14 @@ def read_endpoint(state_dir: str) -> tuple:
         return None, False
     if not isinstance(info, dict):
         return None, False
-    return info, not pid_alive(info.get("pid"))
+    stale = not pid_alive(info.get("pid"))
+    if not stale:
+        born = pid_start_time(info.get("pid"))
+        try:
+            started = float(info["started_unix"])
+        except (KeyError, TypeError, ValueError):
+            started = None
+        if born is not None and started is not None \
+                and born > started + 1.0:     # 1s clock-granularity slack
+            stale = True
+    return info, stale

@@ -149,6 +149,13 @@ class _FleetStub:
     def migrate_put(self, mid, name, stream, length):
         return self.receiver.put_member(mid, name, stream, int(length))
 
+    def migrate_abort(self, payload):
+        mid = (payload or {}).get("migration_id")
+        if not mid:
+            raise MigrationError("refused", "abort needs migration_id")
+        self.receiver.abort(str(mid))
+        return {"ok": True, "migration_id": mid}
+
     def migrate_commit(self, payload):
         rid = (payload or {}).get("request_id")
         if rid and rid in self.committed:
@@ -227,6 +234,43 @@ def test_endpoint_file_pid_staleness(tmp_path):
     (state / "serve.json").write_text(
         json.dumps({"port": 1, "pid": os.getpid()}))
     assert read_endpoint(str(state))[1] is False
+
+
+def test_endpoint_file_detects_recycled_pid(tmp_path, mem_obs):
+    """A live pid is not proof of a live service: after a reboot the
+    dead service's pid can be recycled by an unrelated process. The
+    writer necessarily predates its own serve.json, so a pid holder
+    born AFTER the recorded started_unix is recycled — stale, and
+    startup overwrites instead of refusing the state dir forever."""
+    from mpisppy_tpu.serve.manager import _check_endpoint_file
+    from mpisppy_tpu.serve.migrate import pid_start_time
+    if pid_start_time(os.getpid()) is None:
+        pytest.skip("/proc start-time probe unavailable")
+    state = tmp_path / "state"
+    state.mkdir()
+    # this (live) process stands in for the recycled holder: the file
+    # claims a service that started long before we were born
+    (state / "serve.json").write_text(
+        json.dumps({"port": 1, "pid": os.getpid(),
+                    "started_unix": 0.0}))
+    info, stale = read_endpoint(str(state))
+    assert info["pid"] == os.getpid() and stale is True
+    # a coherent record (writer born before it wrote) stays live
+    (state / "serve.json").write_text(
+        json.dumps({"port": 1, "pid": os.getpid(),
+                    "started_unix": time.time() + 5.0}))
+    assert read_endpoint(str(state))[1] is False
+    # startup: a live FOREIGN pid born after the recorded start reads
+    # as recycled — overwritten, not refused (pid 1 was born at boot,
+    # long after a claimed started_unix of epoch 0)
+    if pid_start_time(1) is not None:
+        (state / "serve.json").write_text(
+            json.dumps({"port": 1, "pid": 1, "started_unix": 0.0}))
+        assert _check_endpoint_file(str(state)) is True
+        # ...while one we cannot date still refuses conservatively
+        (state / "serve.json").write_text(
+            json.dumps({"port": 1, "pid": 1}))
+        assert _check_endpoint_file(str(state)) is False
 
 
 def test_check_endpoint_file_overwrites_dead_refuses_live(tmp_path,
@@ -328,6 +372,11 @@ def test_resolve_interrupted_migration_probes_peer(tmp_path, mem_obs):
         assert resolve_interrupted_migration(peer, "req-x") is False
         svc.committed["req-x"] = {"id": "req-x", "status": "done"}
         assert resolve_interrupted_migration(peer, "req-x") is True
+        # a peer record in the 'migrated' state is the PEER's own
+        # hand-away marker, not ownership — settling ours against it
+        # would lose a round-tripped request
+        svc.committed["req-x"] = {"id": "req-x", "status": "migrated"}
+        assert resolve_interrupted_migration(peer, "req-x") is False
     finally:
         srv.stop()
 
@@ -407,6 +456,10 @@ def test_protocol_torn_transfer_restreams_once_then_aborts(tmp_path,
                 _record(rid="req-t2", bucket="bucket-x"), bundle)
         assert ei.value.reason == "transfer"
         assert "req-t2" not in svc.committed
+        # the donor's best-effort abort released the staged offer —
+        # no migrate_in leak waiting on the receiver's TTL sweep
+        assert svc.receiver.open_offers() == 0
+        assert not os.listdir(os.path.join(svc.state_dir, "migrate_in"))
     finally:
         srv.stop()
 
@@ -466,6 +519,34 @@ def test_receiver_refuses_malformed_offers_and_members(tmp_path):
     assert recv.open_offers() == 0
     with pytest.raises(MigrationError, match="unknown migration"):
         recv.put_member("m1", "hub.npz", io.BytesIO(b"abc"), 3)
+
+
+def test_receiver_sweep_reclaims_abandoned_offers(tmp_path, mem_obs):
+    """A donor that dies (or times out) after a successful offer never
+    sends commit OR abort: the TTL sweep reclaims the staged offer and
+    its migrate_in dir so a long-lived receiver under flaky donors
+    cannot accumulate unbounded disk/memory."""
+    recv = MigrationReceiver(str(tmp_path / "state"), offer_ttl=10.0)
+    recv.offer({"schema": 1, "migration_id": "m-dead",
+                "request": {"id": "r-dead"},
+                "bundle": {"name": "b",
+                           "files": {"hub.npz": {"size": 3,
+                                                 "sha256": "0" * 64}}}})
+    recv.offer({"schema": 1, "migration_id": "m-live",
+                "request": {"id": "r-live"}})
+    t0 = recv._offers["m-dead"]["opened_unix"]
+    assert recv.sweep(now=t0 + 5.0) == 0       # young offers stay
+    assert recv.open_offers() == 2
+    recv._offers["m-dead"]["opened_unix"] = t0 - 60.0
+    assert recv.sweep(now=t0) == 1
+    assert recv.open_offers() == 1
+    assert not os.path.isdir(os.path.join(recv.dir, "m-dead"))
+    assert os.path.isdir(os.path.join(recv.dir, "m-live"))
+    assert obs.counter_value(
+        "serve.migrate.rejected.offer_expired") == 1
+    # a swept offer is gone for good: the late commit refuses
+    with pytest.raises(MigrationError, match="unknown migration"):
+        recv.offer_record("m-dead")
 
 
 # ---------------- Retry-After on the HTTP plane ----------------
@@ -611,6 +692,57 @@ def test_migrate_out_abort_restores_and_books_reason(tmp_path,
             + obs.counter_value("serve.migrate.aborted.refused")
     finally:
         srv.stop()
+
+
+def test_round_trip_handoff_supersedes_stale_migrated_record(
+        tmp_path, mem_obs):
+    """The rolling-deploy round trip (A migrates X to B, A restarts,
+    B drains X back to A): A's leftover 'migrated' record is its
+    hand-AWAY marker, not ownership — the inbound offer/commit must
+    re-admit and supersede it. Acking 'already' here would settle
+    BOTH hosts 'migrated' and silently lose the request."""
+    svc = _service(tmp_path)
+    stale = Request(FARMER, req_id="req-rt", bucket="bucket-x")
+    stale.status = "migrated"
+    stale.peer = "127.0.0.1:9"
+    svc.store.save(stale)
+    rec = _record(rid="req-rt")
+    out = svc.migrate_offer({"schema": 1, "migration_id": "m-rt",
+                             "request": rec, "bundle": None})
+    assert out.get("already") is not True     # round trip re-admits
+    out = svc.migrate_commit({"schema": 1, "migration_id": "m-rt",
+                              "request_id": "req-rt"})
+    assert out["ok"] and out.get("already") is not True
+    landed = svc.store.load("req-rt")
+    assert landed.status == "queued"          # superseded, runnable
+    # whereas a record this host really owns (any non-migrated
+    # status) keeps the idempotency fast path: no double admission
+    out = svc.migrate_offer({"schema": 1, "migration_id": "m-rt2",
+                             "request": rec, "bundle": None})
+    assert out.get("already") is True
+    out = svc.migrate_commit({"schema": 1, "migration_id": "m-rt2",
+                              "request_id": "req-rt"})
+    assert out.get("already") is True
+    assert svc.receiver.open_offers() == 0
+
+
+def test_migrate_commit_refused_while_draining(tmp_path, mem_obs):
+    """The commit guard mirrors the offer guard: an offer staged just
+    before the drain began must not commit onto an evacuating host —
+    the staging drops and the donor (reasoned 'draining' refusal)
+    finishes the wheel locally."""
+    svc = _service(tmp_path)
+    svc.migrate_offer({"schema": 1, "migration_id": "m-dg",
+                       "request": {"id": "req-dg"}, "bundle": None})
+    assert svc.receiver.open_offers() == 1
+    svc._draining = True
+    with pytest.raises(MigrationError) as ei:
+        svc.migrate_commit({"schema": 1, "migration_id": "m-dg",
+                            "request_id": "req-dg"})
+    assert ei.value.reason == "draining"
+    assert svc.receiver.open_offers() == 0    # staging dropped
+    assert svc.store.load("req-dg") is None   # nothing admitted
+    assert obs.counter_value("serve.migrate.rejected.draining") == 1
 
 
 def test_quarantine_poison_pill_after_max_recoveries(tmp_path,

@@ -120,20 +120,32 @@ class Host:
     def start(self, fault_plan=None):
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # a native crash (XLA abort, segfault) leaves no Python
+        # traceback — faulthandler's dump in serve.log is the only
+        # post-mortem a SIGKILL-free abrupt death gets
+        env.setdefault("PYTHONFAULTHANDLER", "1")
         env.pop("MPISPPY_TPU_TELEMETRY_DIR", None)
         env.pop("MPISPPY_TPU_FAULT_PLAN", None)
         if fault_plan:
             env["MPISPPY_TPU_FAULT_PLAN"] = json.dumps(fault_plan)
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "mpisppy_tpu", "serve",
-             "--port", str(self.port), "--state-dir", self.state,
-             "--peers", f"127.0.0.1:{self.peer_port}",
-             "--batch-window", "0.1", "--checkpoint-interval", "0.2",
-             "--migrate-deadline", str(self.migrate_deadline),
-             "--telemetry-dir",
-             os.path.join(self.state, "telemetry")],
-            cwd=REPO, env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        os.makedirs(self.state, exist_ok=True)
+        with open(os.path.join(self.state, "serve.log"), "ab") as log:
+            log.write(f"\n--- host {self.name} incarnation "
+                      f"{self.restarts + 1} "
+                      f"(plan={json.dumps(fault_plan)}) ---\n"
+                      .encode())
+            log.flush()
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "mpisppy_tpu", "serve",
+                 "--port", str(self.port), "--state-dir", self.state,
+                 "--peers", f"127.0.0.1:{self.peer_port}",
+                 "--batch-window", "0.1",
+                 "--checkpoint-interval", "0.2",
+                 "--migrate-deadline", str(self.migrate_deadline),
+                 "--telemetry-dir",
+                 os.path.join(self.state, "telemetry")],
+                cwd=REPO, env=env,
+                stdout=log, stderr=subprocess.STDOUT)
         return self
 
     def alive(self) -> bool:
@@ -273,9 +285,26 @@ def follow(hosts, rid) -> dict | None:
 
 
 def wait_all_terminal(hosts, admitted, budget) -> dict:
+    """Poll both durable stores until every admitted id settles.
+
+    The driver stays the SUPERVISOR here too: a host that dies during
+    the settle wait (a crash just after the last scheduled fault, an
+    abrupt native abort) is restarted — with no fresh fault plan, the
+    schedule is over — so its queued/running requests recover instead
+    of sitting stranded in a dead process until the budget expires and
+    indicts the fleet for work nobody resupervised."""
     end = time.time() + budget
     final = {}
     while time.time() < end:
+        for h in hosts:
+            if not h.alive():
+                rc = h.proc.returncode if h.proc is not None else None
+                print(f"chaos_serve: host {h.name} died (exit {rc}) "
+                      f"during settle; restarting", flush=True)
+                h.reap(timeout=45)
+                h.restarts += 1
+                h.start()
+                h.wait_healthy(budget=120)
         final = {rid: follow(hosts, rid) for rid in admitted}
         if all(r is not None and r["status"] in ("done", "failed")
                for r in final.values()):
@@ -355,6 +384,10 @@ def run_chaos(requests=12, faults=4, seed=7, num_scens=3,
             # capacity or nothing terminates)
             for h in hosts:
                 if not h.alive():
+                    rc = h.proc.returncode \
+                        if h.proc is not None else None
+                    print(f"chaos_serve: host {h.name} down "
+                          f"(exit {rc}); restarting", flush=True)
                     h.reap(timeout=45)
                     h.restarts += 1
                     h.start(fault_plan=_random_plan(rng))
